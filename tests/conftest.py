@@ -1,0 +1,13 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
+import so every test can build multi-device meshes without TPU hardware
+(the pattern recommended for CI in SURVEY.md §4)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

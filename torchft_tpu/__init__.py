@@ -1,0 +1,20 @@
+"""torchft_tpu — TPU-native per-step fault tolerance for JAX training.
+
+A ground-up re-design of the capabilities of pytorch-labs/torchft
+(/root/reference) for TPU hardware: replica groups are TPU slices driven by
+jax/pjit over an ICI mesh; a C++ control plane (lighthouse + per-group
+manager, HTTP/JSON services defined in proto/torchft_tpu.proto) computes
+per-step quorums; cross-replica gradient reduction runs over a
+reconfigurable DCN transport; lagging replicas heal from live checkpoints
+streamed from a peer — all without restarting the job.
+
+Public API parity target: ref torchft/__init__.py:7-20.
+"""
+
+__version__ = "0.1.0"
+
+from torchft_tpu.futures import (  # noqa: F401
+    future_chain,
+    future_timeout,
+    future_wait,
+)

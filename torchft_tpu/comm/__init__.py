@@ -1,0 +1,6 @@
+from torchft_tpu.comm.store import (  # noqa: F401
+    PrefixStore,
+    StoreClient,
+    StoreServer,
+    create_store_client,
+)

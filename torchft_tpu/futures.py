@@ -1,0 +1,192 @@
+"""Future timeout management.
+
+TPU-native analog of the reference's future utilities
+(/root/reference/torchft/futures.py:1-165): a singleton background timer
+thread that can wrap any ``concurrent.futures.Future`` in a deadline, plus
+blocking waits and continuation chaining.
+
+Unlike the reference (which rides torch.futures + an asyncio event loop),
+this implementation is built directly on ``concurrent.futures.Future`` and a
+single deadline-heap thread — there is no torch in this framework, and the
+jax async-dispatch model means device work never lives inside these futures;
+they carry host-side control-plane and DCN-transport results only.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from concurrent.futures import Future
+from datetime import timedelta
+from typing import Callable, Optional, TypeVar
+
+T = TypeVar("T")
+S = TypeVar("S")
+
+__all__ = [
+    "future_timeout",
+    "future_wait",
+    "future_chain",
+    "completed_future",
+    "failed_future",
+    "TimerHandle",
+]
+
+
+class TimerHandle:
+    """Cancellable handle to a pending deadline (ref futures.py:12-29)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cancelled = False
+
+    def cancel(self) -> None:
+        with self._lock:
+            self._cancelled = True
+
+    @property
+    def cancelled(self) -> bool:
+        with self._lock:
+            return self._cancelled
+
+
+class _TimerManager:
+    """Singleton deadline thread: min-heap of (deadline, seq, handle, fn).
+
+    Replaces the reference's asyncio ``call_later`` loop
+    (ref futures.py:32-117) with a plain condition-variable heap, which is
+    easier to reason about under free-threading and has no event-loop
+    startup cost on the hot path.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Condition()
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="torchft_tpu_timers", daemon=True
+            )
+            self._thread.start()
+
+    def call_at(self, deadline: float, fn: Callable[[], None]) -> TimerHandle:
+        handle = TimerHandle()
+        with self._lock:
+            heapq.heappush(self._heap, (deadline, next(self._seq), handle, fn))
+            self._ensure_thread()
+            self._lock.notify()
+        return handle
+
+    def _run(self) -> None:
+        import time
+
+        while True:
+            with self._lock:
+                while not self._heap:
+                    self._lock.wait()
+                deadline, _, handle, fn = self._heap[0]
+                now = time.monotonic()
+                if deadline > now:
+                    self._lock.wait(timeout=deadline - now)
+                    continue
+                heapq.heappop(self._heap)
+            if not handle.cancelled:
+                try:
+                    fn()
+                except Exception:  # timer callbacks must never kill the thread
+                    pass
+
+
+_TIMER_MANAGER = _TimerManager()
+
+
+def _as_seconds(timeout: "float | timedelta") -> float:
+    if isinstance(timeout, timedelta):
+        return timeout.total_seconds()
+    return float(timeout)
+
+
+def future_timeout(fut: "Future[T]", timeout: "float | timedelta") -> "Future[T]":
+    """Return a new future that mirrors ``fut`` but fails with
+    ``TimeoutError`` if ``fut`` is not done within ``timeout``
+    (ref futures.py:120-135).
+
+    The original future is left untouched (it may still complete later);
+    only the returned wrapper observes the deadline.
+    """
+    import time
+
+    out: Future = Future()
+    out.set_running_or_notify_cancel()
+    seconds = _as_seconds(timeout)
+    handle = _TIMER_MANAGER.call_at(
+        time.monotonic() + seconds,
+        lambda: _try_set_exception(
+            out, TimeoutError(f"future timed out after {seconds}s")
+        ),
+    )
+
+    def _done(f: "Future[T]") -> None:
+        handle.cancel()
+        _transfer(f, out)
+
+    fut.add_done_callback(_done)
+    return out
+
+
+def future_wait(fut: "Future[T]", timeout: "float | timedelta") -> T:
+    """Block on ``fut`` up to ``timeout``; raise ``TimeoutError`` on expiry
+    (ref futures.py:138-165)."""
+    return fut.result(timeout=_as_seconds(timeout))
+
+
+def future_chain(fut: "Future[T]", fn: "Callable[[Future[T]], S]") -> "Future[S]":
+    """``then``-style continuation: returns a future holding ``fn(fut)``
+    once ``fut`` completes; ``fn`` receives the *completed* future so it can
+    inspect errors (mirrors torch.futures.Future.then used at ref
+    manager.py:277-291)."""
+    out: Future = Future()
+    out.set_running_or_notify_cancel()
+
+    def _done(f: "Future[T]") -> None:
+        try:
+            out.set_result(fn(f))
+        except Exception as e:
+            _try_set_exception(out, e)
+
+    fut.add_done_callback(_done)
+    return out
+
+
+def completed_future(value: T) -> "Future[T]":
+    f: Future = Future()
+    f.set_result(value)
+    return f
+
+
+def failed_future(exc: Exception) -> "Future[T]":
+    f: Future = Future()
+    f.set_exception(exc)
+    return f
+
+
+def _try_set_exception(fut: Future, exc: Exception) -> None:
+    try:
+        fut.set_exception(exc)
+    except Exception:
+        pass  # already completed
+
+
+def _transfer(src: Future, dst: Future) -> None:
+    exc = src.exception()
+    if exc is not None:
+        _try_set_exception(dst, exc)
+    else:
+        try:
+            dst.set_result(src.result())
+        except Exception:
+            pass  # dst already timed out
