@@ -1,0 +1,371 @@
+// torchft_tpu native control plane — C ABI for Python (ctypes).
+//
+// The reference binds its Rust control plane into Python with pyo3
+// (/root/reference/src/lib.rs); here we expose a plain C ABI consumed via
+// ctypes (pybind11 is not in this image). All returned strings are malloc'd
+// and must be freed with ft_free(). Errors are returned through `char** err`
+// (malloc'd message, NULL on success); timeout errors are prefixed
+// "TIMEOUT: " so the Python layer can raise TimeoutError, mirroring the
+// Status→PyErr mapping at reference lib.rs:321-339.
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "ftjson.h"
+#include "httpx.h"
+#include "lighthouse.h"
+#include "manager.h"
+#include "quorum.h"
+
+namespace {
+
+char* dup_string(const std::string& s) {
+  char* out = static_cast<char*>(malloc(s.size() + 1));
+  memcpy(out, s.data(), s.size() + 1);
+  return out;
+}
+
+void set_err(char** err, const std::string& msg) {
+  if (err != nullptr) *err = dup_string(msg);
+}
+
+struct ClientHandle {
+  std::string host;
+  int port;
+  std::string addr;
+};
+
+// POST helper that converts HTTP/transport failures into err strings.
+bool client_post(ClientHandle* c, const std::string& path,
+                 const std::string& body, int64_t timeout_ms,
+                 std::string* out, char** err) {
+  auto res = fthttp::http_post(c->host, c->port, path, body,
+                               fthttp::now_ms() + timeout_ms);
+  if (!res.error.empty()) {
+    set_err(err, (res.timed_out ? std::string("TIMEOUT: ") : std::string()) +
+                     "rpc to " + c->addr + path + " failed: " + res.error);
+    return false;
+  }
+  if (res.status == 504) {
+    set_err(err, "TIMEOUT: " + path + ": " + res.body);
+    return false;
+  }
+  if (res.status != 200) {
+    set_err(err, path + " failed with status " +
+                     std::to_string(res.status) + ": " + res.body);
+    return false;
+  }
+  *out = res.body;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void ft_free(char* p) { free(p); }
+
+// ---------------------------------------------------------------- lighthouse
+
+void* ft_lighthouse_new(const char* bind_host, int port, const char* hostname,
+                        uint64_t min_replicas, uint64_t join_timeout_ms,
+                        uint64_t quorum_tick_ms, uint64_t heartbeat_timeout_ms,
+                        char** err) {
+  try {
+    ftlighthouse::LighthouseOpts opts;
+    opts.bind_host = bind_host ? bind_host : "0.0.0.0";
+    opts.port = port;
+    opts.hostname = hostname ? hostname : "";
+    opts.quorum.min_replicas = min_replicas;
+    opts.quorum.join_timeout_ms = join_timeout_ms;
+    opts.quorum.quorum_tick_ms = quorum_tick_ms;
+    opts.quorum.heartbeat_timeout_ms = heartbeat_timeout_ms;
+    auto lh = std::make_unique<ftlighthouse::Lighthouse>(std::move(opts));
+    lh->start();
+    return lh.release();
+  } catch (const std::exception& e) {
+    set_err(err, e.what());
+    return nullptr;
+  }
+}
+
+char* ft_lighthouse_address(void* handle) {
+  return dup_string(static_cast<ftlighthouse::Lighthouse*>(handle)->address());
+}
+
+void ft_lighthouse_shutdown(void* handle) {
+  static_cast<ftlighthouse::Lighthouse*>(handle)->shutdown();
+}
+
+void ft_lighthouse_free(void* handle) {
+  delete static_cast<ftlighthouse::Lighthouse*>(handle);
+}
+
+// ------------------------------------------------------------------- manager
+
+void* ft_manager_new(const char* replica_id, const char* lighthouse_addr,
+                     const char* hostname, const char* bind_host, int port,
+                     const char* store_addr, uint64_t world_size,
+                     uint64_t heartbeat_interval_ms,
+                     uint64_t connect_timeout_ms, int exit_on_kill,
+                     char** err) {
+  try {
+    ftmanager::ManagerOpts opts;
+    opts.replica_id = replica_id;
+    opts.lighthouse_addr = lighthouse_addr;
+    opts.hostname = hostname ? hostname : "127.0.0.1";
+    opts.bind_host = bind_host ? bind_host : "0.0.0.0";
+    opts.port = port;
+    opts.store_addr = store_addr ? store_addr : "";
+    opts.world_size = world_size;
+    opts.heartbeat_interval_ms = heartbeat_interval_ms;
+    opts.connect_timeout_ms = connect_timeout_ms;
+    opts.exit_on_kill = exit_on_kill != 0;
+    auto m = std::make_unique<ftmanager::ManagerServer>(std::move(opts));
+    m->start();
+    return m.release();
+  } catch (const std::exception& e) {
+    set_err(err, e.what());
+    return nullptr;
+  }
+}
+
+char* ft_manager_address(void* handle) {
+  return dup_string(static_cast<ftmanager::ManagerServer*>(handle)->address());
+}
+
+int ft_manager_kill_requested(void* handle) {
+  return static_cast<ftmanager::ManagerServer*>(handle)->kill_requested() ? 1
+                                                                          : 0;
+}
+
+void ft_manager_shutdown(void* handle) {
+  static_cast<ftmanager::ManagerServer*>(handle)->shutdown();
+}
+
+void ft_manager_free(void* handle) {
+  delete static_cast<ftmanager::ManagerServer*>(handle);
+}
+
+// ------------------------------------------------------------ manager client
+
+void* ft_manager_client_new(const char* addr, uint64_t connect_timeout_ms,
+                            char** err) {
+  auto* c = new ClientHandle();
+  c->addr = addr;
+  if (!fthttp::parse_http_addr(addr, &c->host, &c->port)) {
+    set_err(err, std::string("bad manager address: ") + addr);
+    delete c;
+    return nullptr;
+  }
+  (void)connect_timeout_ms;  // connections are per-request with retry
+  return c;
+}
+
+char* ft_manager_client_quorum(void* handle, int64_t rank, int64_t step,
+                               const char* checkpoint_metadata,
+                               int shrink_only, uint64_t timeout_ms,
+                               char** err) {
+  auto* c = static_cast<ClientHandle*>(handle);
+  ftjson::Object req;
+  req["rank"] = rank;
+  req["step"] = step;
+  req["checkpoint_metadata"] = std::string(checkpoint_metadata);
+  req["shrink_only"] = shrink_only != 0;
+  std::string out;
+  if (!client_post(c, "/torchft.ManagerService/Quorum",
+                   ftjson::Value(req).dump(),
+                   static_cast<int64_t>(timeout_ms), &out, err)) {
+    return nullptr;
+  }
+  return dup_string(out);
+}
+
+char* ft_manager_client_checkpoint_metadata(void* handle, int64_t rank,
+                                            uint64_t timeout_ms, char** err) {
+  auto* c = static_cast<ClientHandle*>(handle);
+  ftjson::Object req;
+  req["rank"] = rank;
+  std::string out;
+  if (!client_post(c, "/torchft.ManagerService/CheckpointMetadata",
+                   ftjson::Value(req).dump(),
+                   static_cast<int64_t>(timeout_ms), &out, err)) {
+    return nullptr;
+  }
+  try {
+    return dup_string(
+        ftjson::Value::parse(out).get_str("checkpoint_metadata"));
+  } catch (const std::exception& e) {
+    set_err(err, std::string("bad response: ") + e.what());
+    return nullptr;
+  }
+}
+
+int ft_manager_client_should_commit(void* handle, int64_t rank, int64_t step,
+                                    int should_commit, uint64_t timeout_ms,
+                                    char** err) {
+  auto* c = static_cast<ClientHandle*>(handle);
+  ftjson::Object req;
+  req["rank"] = rank;
+  req["step"] = step;
+  req["should_commit"] = should_commit != 0;
+  std::string out;
+  if (!client_post(c, "/torchft.ManagerService/ShouldCommit",
+                   ftjson::Value(req).dump(),
+                   static_cast<int64_t>(timeout_ms), &out, err)) {
+    return -1;
+  }
+  try {
+    return ftjson::Value::parse(out).get_bool("should_commit") ? 1 : 0;
+  } catch (const std::exception& e) {
+    set_err(err, std::string("bad response: ") + e.what());
+    return -1;
+  }
+}
+
+int ft_manager_client_kill(void* handle, const char* msg, uint64_t timeout_ms,
+                           char** err) {
+  auto* c = static_cast<ClientHandle*>(handle);
+  ftjson::Object req;
+  req["msg"] = std::string(msg);
+  // The far side may _exit(1) before replying, so post-send transport
+  // errors are expected and ignored — but a connect failure means the kill
+  // never reached anything and must surface.
+  auto res = fthttp::http_post(c->host, c->port,
+                               "/torchft.ManagerService/Kill",
+                               ftjson::Value(req).dump(),
+                               fthttp::now_ms() +
+                                   static_cast<int64_t>(timeout_ms));
+  if (!res.error.empty() &&
+      res.error.rfind("connect deadline exceeded", 0) == 0) {
+    set_err(err, "TIMEOUT: kill rpc could not connect to " + c->addr + ": " +
+                     res.error);
+    return -1;
+  }
+  return 0;
+}
+
+void ft_manager_client_free(void* handle) {
+  delete static_cast<ClientHandle*>(handle);
+}
+
+// --------------------------------------------------------- lighthouse client
+
+int ft_lighthouse_client_heartbeat(const char* lighthouse_addr,
+                                   const char* replica_id,
+                                   uint64_t timeout_ms, char** err) {
+  ClientHandle c;
+  c.addr = lighthouse_addr;
+  if (!fthttp::parse_http_addr(lighthouse_addr, &c.host, &c.port)) {
+    set_err(err, std::string("bad lighthouse address: ") + lighthouse_addr);
+    return -1;
+  }
+  ftjson::Object req;
+  req["replica_id"] = std::string(replica_id);
+  std::string out;
+  return client_post(&c, "/torchft.LighthouseService/Heartbeat",
+                     ftjson::Value(req).dump(),
+                     static_cast<int64_t>(timeout_ms), &out, err)
+             ? 0
+             : -1;
+}
+
+char* ft_lighthouse_client_quorum(const char* lighthouse_addr,
+                                  const char* requester_json,
+                                  uint64_t timeout_ms, char** err) {
+  ClientHandle c;
+  c.addr = lighthouse_addr;
+  if (!fthttp::parse_http_addr(lighthouse_addr, &c.host, &c.port)) {
+    set_err(err, std::string("bad lighthouse address: ") + lighthouse_addr);
+    return nullptr;
+  }
+  try {
+    ftjson::Object req;
+    req["requester"] = ftjson::Value::parse(requester_json);
+    std::string out;
+    if (!client_post(&c, "/torchft.LighthouseService/Quorum",
+                     ftjson::Value(req).dump(),
+                     static_cast<int64_t>(timeout_ms), &out, err)) {
+      return nullptr;
+    }
+    return dup_string(out);
+  } catch (const std::exception& e) {
+    set_err(err, e.what());
+    return nullptr;
+  }
+}
+
+// ------------------------------------------------------------- pure kernels
+// Exposed so the Python test suite can drive the decision kernels directly
+// (the reference tests its Rust kernels in-file; we test from pytest).
+
+char* ft_quorum_compute(int64_t now_ms, const char* state_json,
+                        const char* opts_json, char** err) {
+  try {
+    auto state_v = ftjson::Value::parse(state_json);
+    ftquorum::QuorumState state;
+    for (const auto& p : state_v.get("participants").as_array()) {
+      ftquorum::ParticipantDetails d;
+      d.joined_ms = p.get_int("joined_ms");
+      d.member = ftquorum::Member::from_json(p.get("member"));
+      state.participants[d.member.replica_id] = d;
+    }
+    if (state_v.has("heartbeats")) {
+      for (const auto& kv : state_v.get("heartbeats").as_object()) {
+        state.heartbeats[kv.first] = kv.second.as_int();
+      }
+    }
+    if (state_v.has("prev_quorum") && !state_v.get("prev_quorum").is_null()) {
+      state.prev_quorum =
+          ftquorum::QuorumInfo::from_json(state_v.get("prev_quorum"));
+    }
+    auto opts_v = ftjson::Value::parse(opts_json);
+    ftquorum::QuorumOpts opts;
+    opts.min_replicas =
+        static_cast<uint64_t>(opts_v.get_int("min_replicas", 1));
+    opts.join_timeout_ms =
+        static_cast<uint64_t>(opts_v.get_int("join_timeout_ms", 60000));
+    opts.heartbeat_timeout_ms =
+        static_cast<uint64_t>(opts_v.get_int("heartbeat_timeout_ms", 5000));
+    auto decision = ftquorum::quorum_compute(now_ms, state, opts);
+    ftjson::Object out;
+    if (decision.quorum.has_value()) {
+      ftjson::Array members;
+      for (const auto& m : *decision.quorum) members.push_back(m.to_json());
+      out["quorum"] = ftjson::Value(std::move(members));
+    } else {
+      out["quorum"] = ftjson::Value(nullptr);
+    }
+    out["reason"] = decision.reason;
+    return dup_string(ftjson::Value(out).dump());
+  } catch (const std::exception& e) {
+    set_err(err, e.what());
+    return nullptr;
+  }
+}
+
+char* ft_compute_quorum_results(const char* replica_id, int64_t rank,
+                                const char* quorum_json, char** err) {
+  try {
+    auto quorum =
+        ftquorum::QuorumInfo::from_json(ftjson::Value::parse(quorum_json));
+    auto results = ftquorum::compute_quorum_results(replica_id, rank, quorum);
+    return dup_string(results.to_json().dump());
+  } catch (const std::exception& e) {
+    set_err(err, e.what());
+    return nullptr;
+  }
+}
+
+// JSON round-trip helper for ftjson unit tests.
+char* ft_json_roundtrip(const char* text, char** err) {
+  try {
+    return dup_string(ftjson::Value::parse(text).dump());
+  } catch (const std::exception& e) {
+    set_err(err, e.what());
+    return nullptr;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,476 @@
+// torchft_tpu native control plane — minimal JSON value/parser/serializer.
+//
+// The control plane speaks HTTP/1.1 + JSON renderings of the messages in
+// proto/torchft_tpu.proto (the reference speaks gRPC/protobuf; this image has
+// no grpc++, and the control-plane traffic is low-rate, so a dependency-free
+// JSON wire format is the right trade). This is a deliberately small, strict
+// JSON implementation: UTF-8 pass-through, \uXXXX decode, int64/double split.
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ftjson {
+
+class Value;
+using Array = std::vector<Value>;
+using Object = std::map<std::string, Value>;
+
+class Value {
+ public:
+  enum class Type { Null, Bool, Int, Double, String, Arr, Obj };
+
+  Value() : type_(Type::Null) {}
+  Value(std::nullptr_t) : type_(Type::Null) {}
+  Value(bool b) : type_(Type::Bool), bool_(b) {}
+  Value(int v) : type_(Type::Int), int_(v) {}
+  Value(int64_t v) : type_(Type::Int), int_(v) {}
+  Value(uint64_t v) : type_(Type::Int), int_(static_cast<int64_t>(v)) {}
+  Value(double v) : type_(Type::Double), dbl_(v) {}
+  Value(const char* s) : type_(Type::String), str_(s) {}
+  Value(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Value(Array a) : type_(Type::Arr), arr_(std::move(a)) {}
+  Value(Object o) : type_(Type::Obj), obj_(std::move(o)) {}
+
+  static Value object() { return Value(Object{}); }
+  static Value array() { return Value(Array{}); }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Obj; }
+  bool is_array() const { return type_ == Type::Arr; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const {
+    return type_ == Type::Int || type_ == Type::Double;
+  }
+
+  bool as_bool() const {
+    require(Type::Bool);
+    return bool_;
+  }
+  int64_t as_int() const {
+    if (type_ == Type::Double) return static_cast<int64_t>(dbl_);
+    require(Type::Int);
+    return int_;
+  }
+  double as_double() const {
+    if (type_ == Type::Int) return static_cast<double>(int_);
+    require(Type::Double);
+    return dbl_;
+  }
+  const std::string& as_str() const {
+    require(Type::String);
+    return str_;
+  }
+  const Array& as_array() const {
+    require(Type::Arr);
+    return arr_;
+  }
+  Array& as_array() {
+    require(Type::Arr);
+    return arr_;
+  }
+  const Object& as_object() const {
+    require(Type::Obj);
+    return obj_;
+  }
+  Object& as_object() {
+    require(Type::Obj);
+    return obj_;
+  }
+
+  bool has(const std::string& key) const {
+    return type_ == Type::Obj && obj_.count(key) > 0;
+  }
+  // Object lookup; returns Null value for missing keys (proto3-style default).
+  const Value& get(const std::string& key) const {
+    static const Value kNull;
+    if (type_ != Type::Obj) return kNull;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? kNull : it->second;
+  }
+  Value& operator[](const std::string& key) {
+    require(Type::Obj);
+    return obj_[key];
+  }
+  void push_back(Value v) {
+    require(Type::Arr);
+    arr_.push_back(std::move(v));
+  }
+  size_t size() const {
+    if (type_ == Type::Arr) return arr_.size();
+    if (type_ == Type::Obj) return obj_.size();
+    return 0;
+  }
+
+  // Typed getters with defaults, for message decoding.
+  int64_t get_int(const std::string& key, int64_t dflt = 0) const {
+    const Value& v = get(key);
+    return v.is_number() ? v.as_int() : dflt;
+  }
+  bool get_bool(const std::string& key, bool dflt = false) const {
+    const Value& v = get(key);
+    return v.type() == Type::Bool ? v.as_bool() : dflt;
+  }
+  std::string get_str(const std::string& key,
+                      const std::string& dflt = "") const {
+    const Value& v = get(key);
+    return v.is_string() ? v.as_str() : dflt;
+  }
+
+  std::string dump() const {
+    std::string out;
+    write(out);
+    return out;
+  }
+
+  static Value parse(const std::string& text) {
+    Parser p(text);
+    Value v = p.parse_value();
+    p.skip_ws();
+    if (!p.at_end()) throw std::runtime_error("json: trailing characters");
+    return v;
+  }
+
+ private:
+  void require(Type t) const {
+    if (type_ != t) throw std::runtime_error("json: wrong type access");
+  }
+
+  void write(std::string& out) const {
+    switch (type_) {
+      case Type::Null:
+        out += "null";
+        break;
+      case Type::Bool:
+        out += bool_ ? "true" : "false";
+        break;
+      case Type::Int: {
+        char buf[32];
+        snprintf(buf, sizeof(buf), "%lld", static_cast<long long>(int_));
+        out += buf;
+        break;
+      }
+      case Type::Double: {
+        if (std::isfinite(dbl_)) {
+          char buf[40];
+          snprintf(buf, sizeof(buf), "%.17g", dbl_);
+          out += buf;
+        } else {
+          out += "null";
+        }
+        break;
+      }
+      case Type::String:
+        write_string(out, str_);
+        break;
+      case Type::Arr: {
+        out += '[';
+        bool first = true;
+        for (const auto& v : arr_) {
+          if (!first) out += ',';
+          first = false;
+          v.write(out);
+        }
+        out += ']';
+        break;
+      }
+      case Type::Obj: {
+        out += '{';
+        bool first = true;
+        for (const auto& kv : obj_) {
+          if (!first) out += ',';
+          first = false;
+          write_string(out, kv.first);
+          out += ':';
+          kv.second.write(out);
+        }
+        out += '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::string& out, const std::string& s) {
+    out += '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"':
+          out += "\\\"";
+          break;
+        case '\\':
+          out += "\\\\";
+          break;
+        case '\b':
+          out += "\\b";
+          break;
+        case '\f':
+          out += "\\f";
+          break;
+        case '\n':
+          out += "\\n";
+          break;
+        case '\r':
+          out += "\\r";
+          break;
+        case '\t':
+          out += "\\t";
+          break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out += buf;
+          } else {
+            out += static_cast<char>(c);
+          }
+      }
+    }
+    out += '"';
+  }
+
+  class Parser {
+   public:
+    explicit Parser(const std::string& text) : text_(text), pos_(0) {}
+
+    bool at_end() const { return pos_ >= text_.size(); }
+
+    void skip_ws() {
+      while (pos_ < text_.size() &&
+             (text_[pos_] == ' ' || text_[pos_] == '\t' ||
+              text_[pos_] == '\n' || text_[pos_] == '\r'))
+        pos_++;
+    }
+
+    Value parse_value() {
+      skip_ws();
+      if (at_end()) throw std::runtime_error("json: unexpected end");
+      char c = text_[pos_];
+      switch (c) {
+        case '{':
+          return parse_object();
+        case '[':
+          return parse_array();
+        case '"':
+          return Value(parse_string());
+        case 't':
+          expect("true");
+          return Value(true);
+        case 'f':
+          expect("false");
+          return Value(false);
+        case 'n':
+          expect("null");
+          return Value(nullptr);
+        default:
+          return parse_number();
+      }
+    }
+
+   private:
+    void expect(const char* word) {
+      size_t n = std::string(word).size();
+      if (text_.compare(pos_, n, word) != 0)
+        throw std::runtime_error("json: invalid literal");
+      pos_ += n;
+    }
+
+    Value parse_object() {
+      pos_++;  // '{'
+      Object obj;
+      skip_ws();
+      if (peek() == '}') {
+        pos_++;
+        return Value(std::move(obj));
+      }
+      while (true) {
+        skip_ws();
+        std::string key = parse_string();
+        skip_ws();
+        if (peek() != ':') throw std::runtime_error("json: expected ':'");
+        pos_++;
+        obj[key] = parse_value();
+        skip_ws();
+        char c = peek();
+        if (c == ',') {
+          pos_++;
+          continue;
+        }
+        if (c == '}') {
+          pos_++;
+          return Value(std::move(obj));
+        }
+        throw std::runtime_error("json: expected ',' or '}'");
+      }
+    }
+
+    Value parse_array() {
+      pos_++;  // '['
+      Array arr;
+      skip_ws();
+      if (peek() == ']') {
+        pos_++;
+        return Value(std::move(arr));
+      }
+      while (true) {
+        arr.push_back(parse_value());
+        skip_ws();
+        char c = peek();
+        if (c == ',') {
+          pos_++;
+          continue;
+        }
+        if (c == ']') {
+          pos_++;
+          return Value(std::move(arr));
+        }
+        throw std::runtime_error("json: expected ',' or ']'");
+      }
+    }
+
+    std::string parse_string() {
+      if (peek() != '"') throw std::runtime_error("json: expected string");
+      pos_++;
+      std::string out;
+      while (true) {
+        if (at_end()) throw std::runtime_error("json: unterminated string");
+        char c = text_[pos_++];
+        if (c == '"') return out;
+        if (c == '\\') {
+          if (at_end()) throw std::runtime_error("json: bad escape");
+          char e = text_[pos_++];
+          switch (e) {
+            case '"':
+              out += '"';
+              break;
+            case '\\':
+              out += '\\';
+              break;
+            case '/':
+              out += '/';
+              break;
+            case 'b':
+              out += '\b';
+              break;
+            case 'f':
+              out += '\f';
+              break;
+            case 'n':
+              out += '\n';
+              break;
+            case 'r':
+              out += '\r';
+              break;
+            case 't':
+              out += '\t';
+              break;
+            case 'u': {
+              unsigned cp = parse_hex4();
+              if (cp >= 0xD800 && cp <= 0xDBFF) {
+                // surrogate pair
+                if (pos_ + 1 < text_.size() && text_[pos_] == '\\' &&
+                    text_[pos_ + 1] == 'u') {
+                  pos_ += 2;
+                  unsigned lo = parse_hex4();
+                  cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                }
+              }
+              append_utf8(out, cp);
+              break;
+            }
+            default:
+              throw std::runtime_error("json: bad escape");
+          }
+        } else {
+          out += c;
+        }
+      }
+    }
+
+    unsigned parse_hex4() {
+      if (pos_ + 4 > text_.size()) throw std::runtime_error("json: bad \\u");
+      unsigned v = 0;
+      for (int i = 0; i < 4; i++) {
+        char c = text_[pos_++];
+        v <<= 4;
+        if (c >= '0' && c <= '9')
+          v |= c - '0';
+        else if (c >= 'a' && c <= 'f')
+          v |= c - 'a' + 10;
+        else if (c >= 'A' && c <= 'F')
+          v |= c - 'A' + 10;
+        else
+          throw std::runtime_error("json: bad \\u digit");
+      }
+      return v;
+    }
+
+    static void append_utf8(std::string& out, unsigned cp) {
+      if (cp < 0x80) {
+        out += static_cast<char>(cp);
+      } else if (cp < 0x800) {
+        out += static_cast<char>(0xC0 | (cp >> 6));
+        out += static_cast<char>(0x80 | (cp & 0x3F));
+      } else if (cp < 0x10000) {
+        out += static_cast<char>(0xE0 | (cp >> 12));
+        out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+        out += static_cast<char>(0x80 | (cp & 0x3F));
+      } else {
+        out += static_cast<char>(0xF0 | (cp >> 18));
+        out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+        out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+        out += static_cast<char>(0x80 | (cp & 0x3F));
+      }
+    }
+
+    Value parse_number() {
+      size_t start = pos_;
+      if (peek() == '-') pos_++;
+      bool is_double = false;
+      while (!at_end()) {
+        char c = text_[pos_];
+        if (c >= '0' && c <= '9') {
+          pos_++;
+        } else if (c == '.' || c == 'e' || c == 'E' || c == '+' || c == '-') {
+          if (c == '.' || c == 'e' || c == 'E') is_double = true;
+          pos_++;
+        } else {
+          break;
+        }
+      }
+      std::string tok = text_.substr(start, pos_ - start);
+      if (tok.empty() || tok == "-")
+        throw std::runtime_error("json: bad number");
+      if (is_double) return Value(std::stod(tok));
+      try {
+        return Value(static_cast<int64_t>(std::stoll(tok)));
+      } catch (...) {
+        return Value(std::stod(tok));
+      }
+    }
+
+    char peek() const {
+      if (at_end()) throw std::runtime_error("json: unexpected end");
+      return text_[pos_];
+    }
+
+    const std::string& text_;
+    size_t pos_;
+  };
+
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double dbl_ = 0.0;
+  std::string str_;
+  Array arr_;
+  Object obj_;
+};
+
+}  // namespace ftjson

@@ -1,0 +1,89 @@
+// torchft_tpu native control plane — minimal HTTP/1.1 server + client.
+//
+// Transport for the control-plane services (Lighthouse/Manager, see
+// proto/torchft_tpu.proto). Thread-per-connection with keep-alive; client
+// timeouts ride an `x-timeout-ms` request header which the server converts
+// into an absolute deadline so *server-side* waits honor client deadlines
+// (the role grpc-timeout parsing plays in the reference, src/timeout.rs).
+// Connection establishment retries with jittered exponential backoff
+// (reference: src/retry.rs, src/net.rs).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace fthttp {
+
+int64_t now_ms();  // monotonic milliseconds
+
+struct Request {
+  std::string method;
+  std::string path;
+  std::string body;
+  std::map<std::string, std::string> headers;  // lowercase keys
+  int64_t deadline_ms = 0;  // absolute (now_ms clock); always set by server
+};
+
+struct Response {
+  int status = 200;
+  std::string content_type = "application/json";
+  std::string body;
+};
+
+using Handler = std::function<Response(const Request&)>;
+
+class HttpServer {
+ public:
+  // Binds immediately (port 0 = ephemeral); serving starts on start().
+  HttpServer(const std::string& host, int port);
+  ~HttpServer();
+
+  void set_handler(Handler h) { handler_ = std::move(h); }
+  void start();
+  void shutdown();
+
+  int port() const { return port_; }
+  const std::string& host() const { return host_; }
+
+ private:
+  void accept_loop();
+  void serve_conn(int fd);
+
+  std::string host_;
+  int port_ = 0;
+  int listen_fd_ = -1;
+  Handler handler_;
+  std::thread accept_thread_;
+  std::atomic<bool> stopping_{false};
+  std::atomic<int> active_conns_{0};
+  std::mutex conn_mu_;
+  std::vector<int> conn_fds_;
+};
+
+struct ClientResult {
+  int status = 0;          // HTTP status; 0 on transport error
+  std::string body;
+  std::string error;       // non-empty on transport error/timeout
+  bool timed_out = false;  // transport-level deadline expiry
+};
+
+// Parse "http://host:port[/...]" or "host:port" into host/port.
+bool parse_http_addr(const std::string& addr, std::string* host, int* port);
+
+// POST with an absolute deadline; sets x-timeout-ms from the remaining
+// budget; retries connection establishment with backoff until the deadline.
+ClientResult http_post(const std::string& host, int port,
+                       const std::string& path, const std::string& body,
+                       int64_t deadline_ms);
+
+ClientResult http_get(const std::string& host, int port,
+                      const std::string& path, int64_t deadline_ms);
+
+}  // namespace fthttp

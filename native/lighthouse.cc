@@ -1,0 +1,325 @@
+#include "lighthouse.h"
+
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <sstream>
+
+namespace ftlighthouse {
+
+using fthttp::Request;
+using fthttp::Response;
+using ftquorum::Member;
+using ftquorum::QuorumInfo;
+
+namespace {
+int64_t wall_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+std::string html_escape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    switch (c) {
+      case '<':
+        out += "&lt;";
+        break;
+      case '>':
+        out += "&gt;";
+        break;
+      case '&':
+        out += "&amp;";
+        break;
+      case '"':
+        out += "&quot;";
+        break;
+      case '\'':
+        out += "&#39;";
+        break;
+      default:
+        out += c;
+    }
+  }
+  return out;
+}
+}  // namespace
+
+Lighthouse::Lighthouse(LighthouseOpts opts)
+    : opts_(std::move(opts)), server_(opts_.bind_host, opts_.port) {
+  server_.set_handler([this](const Request& req) { return handle(req); });
+}
+
+Lighthouse::~Lighthouse() { shutdown(); }
+
+void Lighthouse::start() {
+  server_.start();
+  tick_thread_ = std::thread([this] { tick_loop(); });
+}
+
+void Lighthouse::shutdown() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stopping_) return;
+    stopping_ = true;
+  }
+  cv_.notify_all();
+  if (tick_thread_.joinable()) tick_thread_.join();
+  server_.shutdown();
+}
+
+std::string Lighthouse::address() const {
+  std::string host = opts_.hostname;
+  if (host.empty()) {
+    if (!opts_.bind_host.empty() && opts_.bind_host != "0.0.0.0" &&
+        opts_.bind_host != "[::]") {
+      host = opts_.bind_host;
+    } else {
+      char buf[256];
+      host = (gethostname(buf, sizeof(buf)) == 0) ? buf : "127.0.0.1";
+    }
+  }
+  return "http://" + host + ":" + std::to_string(server_.port());
+}
+
+void Lighthouse::tick_loop() {
+  std::unique_lock<std::mutex> lk(mu_);
+  while (!stopping_) {
+    tick_locked();
+    cv_.wait_for(lk, std::chrono::milliseconds(opts_.quorum.quorum_tick_ms),
+                 [this] { return stopping_; });
+  }
+}
+
+void Lighthouse::tick_locked() {
+  auto decision =
+      ftquorum::quorum_compute(fthttp::now_ms(), state_, opts_.quorum);
+  last_reason_ = decision.reason;
+  if (!decision.quorum.has_value()) return;
+
+  // Bump the quorum id only when membership changed (ref lighthouse.rs
+  // 272-283); the id is what triggers transport reconfiguration downstream.
+  if (!state_.prev_quorum.has_value() ||
+      ftquorum::quorum_changed(*decision.quorum,
+                               state_.prev_quorum->participants)) {
+    quorum_id_ += 1;
+  }
+
+  QuorumInfo q;
+  q.quorum_id = quorum_id_;
+  q.participants = *decision.quorum;
+  q.created_ms = wall_ms();
+
+  state_.prev_quorum = q;
+  // Each quorum round requires a fresh request from every replica.
+  state_.participants.clear();
+  latest_quorum_ = q;
+  quorum_seq_ += 1;
+  cv_.notify_all();
+}
+
+Response Lighthouse::handle(const Request& req) {
+  if (req.path == "/torchft.LighthouseService/Quorum" &&
+      req.method == "POST") {
+    return handle_quorum(req);
+  }
+  if (req.path == "/torchft.LighthouseService/Heartbeat" &&
+      req.method == "POST") {
+    return handle_heartbeat(req);
+  }
+  if (req.path == "/status" && req.method == "GET") {
+    return handle_status();
+  }
+  if (req.path == "/" && req.method == "GET") {
+    // Dashboard shell: vanilla-JS 1s polling of /status (the reference uses
+    // htmx for the same cadence, templates/index.html).
+    static const char* kIndex = R"html(<!DOCTYPE html>
+<html><head><title>torchft_tpu lighthouse</title>
+<style>
+body { font-family: monospace; margin: 2em; background: #101418; color: #d8e0e8; }
+h1 { color: #7fd4ff; } table { border-collapse: collapse; }
+td, th { border: 1px solid #3a4654; padding: 4px 10px; text-align: left; }
+.recovering { color: #ffb347; } .dead { color: #ff6b6b; }
+button { background: #ff6b6b; border: none; padding: 3px 8px; cursor: pointer; }
+</style></head>
+<body><h1>torchft_tpu lighthouse</h1><div id="status">loading…</div>
+<script>
+async function poll() {
+  try {
+    const r = await fetch('/status');
+    document.getElementById('status').innerHTML = await r.text();
+  } catch (e) {}
+}
+poll(); setInterval(poll, 1000);
+async function killReplica(id) { await fetch('/replica/' + id + '/kill', {method: 'POST'}); }
+</script></body></html>)html";
+    return Response{200, "text/html", kIndex};
+  }
+  // POST /replica/{id}/kill
+  const std::string kKillPrefix = "/replica/";
+  if (req.method == "POST" && req.path.rfind(kKillPrefix, 0) == 0) {
+    std::string rest = req.path.substr(kKillPrefix.size());
+    size_t slash = rest.find('/');
+    if (slash != std::string::npos && rest.substr(slash) == "/kill") {
+      return handle_kill(rest.substr(0, slash));
+    }
+  }
+  return Response{404, "text/plain", "not found"};
+}
+
+Response Lighthouse::handle_quorum(const Request& req) {
+  Member requester;
+  try {
+    auto body = ftjson::Value::parse(req.body);
+    if (!body.has("requester")) {
+      return Response{400, "application/json",
+                      "{\"error\":\"missing requester\"}"};
+    }
+    requester = Member::from_json(body.get("requester"));
+  } catch (const std::exception& e) {
+    return Response{400, "application/json",
+                    std::string("{\"error\":\"bad request: ") + e.what() +
+                        "\"}"};
+  }
+
+  std::unique_lock<std::mutex> lk(mu_);
+  int64_t now = fthttp::now_ms();
+  // Implicit heartbeat + join (ref lighthouse.rs:455-478).
+  state_.heartbeats[requester.replica_id] = now;
+  state_.participants[requester.replica_id] = {now, requester};
+  uint64_t seen = quorum_seq_;
+  tick_locked();  // proactive evaluation
+
+  while (true) {
+    while (quorum_seq_ == seen && !stopping_) {
+      auto deadline = std::chrono::steady_clock::now() +
+                      std::chrono::milliseconds(
+                          std::max<int64_t>(1, req.deadline_ms -
+                                                   fthttp::now_ms()));
+      if (cv_.wait_until(lk, deadline) == std::cv_status::timeout &&
+          quorum_seq_ == seen) {
+        if (fthttp::now_ms() >= req.deadline_ms) {
+          return Response{504, "application/json",
+                          "{\"error\":\"quorum deadline exceeded\"}"};
+        }
+      }
+    }
+    if (stopping_) {
+      return Response{503, "application/json",
+                      "{\"error\":\"lighthouse shutting down\"}"};
+    }
+    seen = quorum_seq_;
+    bool in_quorum = false;
+    for (const auto& p : latest_quorum_->participants) {
+      if (p.replica_id == requester.replica_id) {
+        in_quorum = true;
+        break;
+      }
+    }
+    if (in_quorum) break;
+    // Announced quorum doesn't include us: rejoin and wait for the next one
+    // (ref lighthouse.rs:480-501).
+    int64_t now2 = fthttp::now_ms();
+    state_.heartbeats[requester.replica_id] = now2;
+    state_.participants[requester.replica_id] = {now2, requester};
+  }
+
+  ftjson::Object reply;
+  reply["quorum"] = latest_quorum_->to_json();
+  return Response{200, "application/json", ftjson::Value(reply).dump()};
+}
+
+Response Lighthouse::handle_heartbeat(const Request& req) {
+  try {
+    auto body = ftjson::Value::parse(req.body);
+    std::string replica_id = body.get_str("replica_id");
+    std::lock_guard<std::mutex> lk(mu_);
+    state_.heartbeats[replica_id] = fthttp::now_ms();
+  } catch (const std::exception& e) {
+    return Response{400, "application/json",
+                    std::string("{\"error\":\"") + e.what() + "\"}"};
+  }
+  return Response{200, "application/json", "{}"};
+}
+
+Response Lighthouse::handle_status() {
+  std::ostringstream html;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto decision =
+        ftquorum::quorum_compute(fthttp::now_ms(), state_, opts_.quorum);
+    html << "<p>quorum status: " << html_escape(decision.reason) << "</p>";
+    if (state_.prev_quorum.has_value()) {
+      const auto& q = *state_.prev_quorum;
+      int64_t max_step = 0;
+      for (const auto& p : q.participants)
+        max_step = std::max(max_step, p.step);
+      html << "<p>quorum id: " << q.quorum_id << " &middot; "
+           << q.participants.size() << " participants &middot; age "
+           << (wall_ms() - q.created_ms) / 1000 << "s &middot; max step "
+           << max_step << "</p><table><tr><th>replica</th><th>step</th>"
+           << "<th>manager address</th><th>store</th><th></th></tr>";
+      for (const auto& p : q.participants) {
+        bool recovering = p.step != max_step;
+        html << "<tr class=\"" << (recovering ? "recovering" : "") << "\"><td>"
+             << html_escape(p.replica_id) << "</td><td>" << p.step
+             << (recovering ? " (recovering)" : "") << "</td><td>"
+             << html_escape(p.address) << "</td><td>"
+             << html_escape(p.store_address) << "</td><td><button "
+             << "onclick=\"killReplica('" << html_escape(p.replica_id)
+             << "')\">kill</button></td></tr>";
+      }
+      html << "</table>";
+    } else {
+      html << "<p>no quorum formed yet</p>";
+    }
+    html << "<h3>heartbeats</h3><table><tr><th>replica</th><th>age</th></tr>";
+    int64_t now = fthttp::now_ms();
+    for (const auto& hb : state_.heartbeats) {
+      bool dead = now - hb.second >=
+                  static_cast<int64_t>(opts_.quorum.heartbeat_timeout_ms);
+      html << "<tr class=\"" << (dead ? "dead" : "") << "\"><td>"
+           << html_escape(hb.first) << "</td><td>" << (now - hb.second)
+           << "ms</td></tr>";
+    }
+    html << "</table>";
+  }
+  return Response{200, "text/html", html.str()};
+}
+
+Response Lighthouse::handle_kill(const std::string& replica_id) {
+  std::string manager_addr;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!state_.prev_quorum.has_value()) {
+      return Response{500, "text/plain", "failed to find replica"};
+    }
+    for (const auto& m : state_.prev_quorum->participants) {
+      if (m.replica_id == replica_id) {
+        manager_addr = m.address;
+        break;
+      }
+    }
+  }
+  if (manager_addr.empty()) {
+    return Response{500, "text/plain", "failed to find replica"};
+  }
+  std::string host;
+  int port = 0;
+  if (!fthttp::parse_http_addr(manager_addr, &host, &port)) {
+    return Response{500, "text/plain", "bad manager address"};
+  }
+  ftjson::Object body;
+  body["msg"] = std::string("killed from dashboard");
+  auto res =
+      fthttp::http_post(host, port, "/torchft.ManagerService/Kill",
+                        ftjson::Value(body).dump(), fthttp::now_ms() + 10000);
+  if (!res.error.empty()) {
+    return Response{500, "text/plain", "kill failed: " + res.error};
+  }
+  return Response{200, "text/plain", "ok"};
+}
+
+}  // namespace ftlighthouse

@@ -1,0 +1,70 @@
+// torchft_tpu native control plane — Lighthouse server.
+//
+// Global quorum service (reference: /root/reference/src/lighthouse.rs).
+// Serves, on one port:
+//   POST /torchft.LighthouseService/Quorum     (long-poll until quorum)
+//   POST /torchft.LighthouseService/Heartbeat
+//   GET  /            dashboard HTML
+//   GET  /status      dashboard fragment (polled by the dashboard JS)
+//   POST /replica/{id}/kill   proxies a Kill RPC to that replica's manager
+//
+// Design: one mutex + condition_variable guard all state; the quorum RPC
+// long-polls on a monotonically increasing quorum sequence number (the
+// C++ rendering of the reference's tokio broadcast channel); a tick thread
+// re-evaluates the decision kernel every quorum_tick_ms.
+#pragma once
+
+#include <condition_variable>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <thread>
+
+#include "httpx.h"
+#include "quorum.h"
+
+namespace ftlighthouse {
+
+struct LighthouseOpts {
+  std::string bind_host = "0.0.0.0";
+  int port = 0;                  // 0 = ephemeral
+  std::string hostname = "";     // advertised host; "" = bind_host or 127.0.0.1
+  ftquorum::QuorumOpts quorum;
+};
+
+class Lighthouse {
+ public:
+  explicit Lighthouse(LighthouseOpts opts);
+  ~Lighthouse();
+
+  void start();
+  void shutdown();
+  std::string address() const;  // http://host:port
+  int port() const { return server_.port(); }
+
+ private:
+  fthttp::Response handle(const fthttp::Request& req);
+  fthttp::Response handle_quorum(const fthttp::Request& req);
+  fthttp::Response handle_heartbeat(const fthttp::Request& req);
+  fthttp::Response handle_status();
+  fthttp::Response handle_kill(const std::string& replica_id);
+  // Runs the decision kernel; on success publishes a new quorum and wakes
+  // waiters. Caller must hold mu_.
+  void tick_locked();
+  void tick_loop();
+
+  LighthouseOpts opts_;
+  fthttp::HttpServer server_;
+  std::thread tick_thread_;
+
+  mutable std::mutex mu_;
+  std::condition_variable cv_;
+  ftquorum::QuorumState state_;
+  int64_t quorum_id_ = 0;
+  uint64_t quorum_seq_ = 0;
+  std::optional<ftquorum::QuorumInfo> latest_quorum_;
+  std::string last_reason_;
+  bool stopping_ = false;
+};
+
+}  // namespace ftlighthouse

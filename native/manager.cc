@@ -1,0 +1,268 @@
+#include "manager.h"
+
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+
+namespace ftmanager {
+
+using fthttp::Request;
+using fthttp::Response;
+using ftquorum::Member;
+using ftquorum::QuorumInfo;
+
+ManagerServer::ManagerServer(ManagerOpts opts)
+    : opts_(std::move(opts)), server_(opts_.bind_host, opts_.port) {
+  server_.set_handler([this](const Request& req) { return handle(req); });
+}
+
+ManagerServer::~ManagerServer() { shutdown(); }
+
+std::string ManagerServer::address() const {
+  return "http://" + opts_.hostname + ":" + std::to_string(server_.port());
+}
+
+void ManagerServer::start() {
+  // Fail fast if the lighthouse is unreachable (parity with the eager
+  // lighthouse_client_new in the reference ctor).
+  std::string host;
+  int port = 0;
+  if (!fthttp::parse_http_addr(opts_.lighthouse_addr, &host, &port)) {
+    throw std::runtime_error("bad lighthouse address: " +
+                             opts_.lighthouse_addr);
+  }
+  ftjson::Object hb;
+  hb["replica_id"] = opts_.replica_id;
+  auto res = fthttp::http_post(
+      host, port, "/torchft.LighthouseService/Heartbeat",
+      ftjson::Value(hb).dump(),
+      fthttp::now_ms() + static_cast<int64_t>(opts_.connect_timeout_ms));
+  if (!res.error.empty()) {
+    throw std::runtime_error("could not reach lighthouse at " +
+                             opts_.lighthouse_addr + ": " + res.error);
+  }
+  server_.start();
+  heartbeat_thread_ = std::thread([this] { heartbeat_loop(); });
+}
+
+void ManagerServer::shutdown() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stopping_) return;
+    stopping_ = true;
+  }
+  cv_.notify_all();
+  if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
+  server_.shutdown();
+}
+
+void ManagerServer::heartbeat_loop() {
+  std::string host;
+  int port = 0;
+  fthttp::parse_http_addr(opts_.lighthouse_addr, &host, &port);
+  ftjson::Object hb;
+  hb["replica_id"] = opts_.replica_id;
+  std::string body = ftjson::Value(hb).dump();
+  std::unique_lock<std::mutex> lk(mu_);
+  while (!stopping_) {
+    lk.unlock();
+    fthttp::http_post(host, port, "/torchft.LighthouseService/Heartbeat",
+                      body, fthttp::now_ms() + 5000);
+    lk.lock();
+    cv_.wait_for(lk,
+                 std::chrono::milliseconds(opts_.heartbeat_interval_ms),
+                 [this] { return stopping_; });
+  }
+}
+
+Response ManagerServer::handle(const Request& req) {
+  if (req.method != "POST") return Response{404, "text/plain", "not found"};
+  if (req.path == "/torchft.ManagerService/Quorum")
+    return handle_quorum(req);
+  if (req.path == "/torchft.ManagerService/CheckpointMetadata")
+    return handle_checkpoint_metadata(req);
+  if (req.path == "/torchft.ManagerService/ShouldCommit")
+    return handle_should_commit(req);
+  if (req.path == "/torchft.ManagerService/Kill") return handle_kill(req);
+  return Response{404, "text/plain", "not found"};
+}
+
+Response ManagerServer::handle_quorum(const Request& req) {
+  int64_t rank, step;
+  std::string ckpt_meta;
+  bool shrink_only;
+  try {
+    auto body = ftjson::Value::parse(req.body);
+    rank = body.get_int("rank");
+    step = body.get_int("step");
+    ckpt_meta = body.get_str("checkpoint_metadata");
+    shrink_only = body.get_bool("shrink_only");
+  } catch (const std::exception& e) {
+    return Response{400, "application/json",
+                    std::string("{\"error\":\"") + e.what() + "\"}"};
+  }
+
+  std::unique_lock<std::mutex> lk(mu_);
+  checkpoint_metadata_[rank] = ckpt_meta;
+  participants_.insert(rank);
+  uint64_t seen = quorum_seq_;
+
+  if (participants_.size() >= opts_.world_size) {
+    // All local ranks joined: this thread carries the single lighthouse
+    // request for the whole group (ref manager.rs:168-211). The lock is
+    // released during the network call (unlike the reference, which keeps
+    // its async mutex held — releasing is strictly better here since other
+    // local RPCs would otherwise block on a cross-host roundtrip).
+    participants_.clear();
+    Member self;
+    self.replica_id = opts_.replica_id;
+    self.address = address();
+    self.store_address = opts_.store_addr;
+    self.step = step;
+    self.world_size = opts_.world_size;
+    self.shrink_only = shrink_only;
+
+    lk.unlock();
+    std::string host;
+    int port = 0;
+    fthttp::parse_http_addr(opts_.lighthouse_addr, &host, &port);
+    ftjson::Object lh_req;
+    lh_req["requester"] = self.to_json();
+    auto res = fthttp::http_post(host, port,
+                                 "/torchft.LighthouseService/Quorum",
+                                 ftjson::Value(lh_req).dump(),
+                                 req.deadline_ms);
+    lk.lock();
+    if (!res.error.empty() || res.status != 200) {
+      std::string msg = !res.error.empty()
+                            ? res.error
+                            : ("lighthouse status " +
+                               std::to_string(res.status) + ": " + res.body);
+      int status = (res.timed_out || res.status == 504) ? 504 : 500;
+      ftjson::Object err;
+      err["error"] = "lighthouse quorum failed: " + msg;
+      return Response{status, "application/json", ftjson::Value(err).dump()};
+    }
+    try {
+      auto parsed = ftjson::Value::parse(res.body);
+      latest_quorum_ = QuorumInfo::from_json(parsed.get("quorum"));
+    } catch (const std::exception& e) {
+      ftjson::Object err;
+      err["error"] = std::string("bad lighthouse response: ") + e.what();
+      return Response{500, "application/json", ftjson::Value(err).dump()};
+    }
+    quorum_seq_ += 1;
+    cv_.notify_all();
+  }
+
+  while (quorum_seq_ == seen && !stopping_) {
+    auto deadline =
+        std::chrono::steady_clock::now() +
+        std::chrono::milliseconds(
+            std::max<int64_t>(1, req.deadline_ms - fthttp::now_ms()));
+    if (cv_.wait_until(lk, deadline) == std::cv_status::timeout &&
+        quorum_seq_ == seen && fthttp::now_ms() >= req.deadline_ms) {
+      return Response{504, "application/json",
+                      "{\"error\":\"quorum deadline exceeded\"}"};
+    }
+  }
+  if (stopping_) {
+    return Response{503, "application/json",
+                    "{\"error\":\"manager shutting down\"}"};
+  }
+
+  try {
+    auto results =
+        ftquorum::compute_quorum_results(opts_.replica_id, rank,
+                                         *latest_quorum_);
+    return Response{200, "application/json", results.to_json().dump()};
+  } catch (const std::exception& e) {
+    ftjson::Object err;
+    err["error"] = e.what();
+    return Response{500, "application/json", ftjson::Value(err).dump()};
+  }
+}
+
+Response ManagerServer::handle_checkpoint_metadata(const Request& req) {
+  int64_t rank;
+  try {
+    rank = ftjson::Value::parse(req.body).get_int("rank");
+  } catch (const std::exception& e) {
+    return Response{400, "application/json",
+                    std::string("{\"error\":\"") + e.what() + "\"}"};
+  }
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = checkpoint_metadata_.find(rank);
+  if (it == checkpoint_metadata_.end()) {
+    return Response{500, "application/json",
+                    "{\"error\":\"rank not found\"}"};
+  }
+  ftjson::Object out;
+  out["checkpoint_metadata"] = it->second;
+  return Response{200, "application/json", ftjson::Value(out).dump()};
+}
+
+Response ManagerServer::handle_should_commit(const Request& req) {
+  int64_t rank;
+  bool should_commit;
+  try {
+    auto body = ftjson::Value::parse(req.body);
+    rank = body.get_int("rank");
+    should_commit = body.get_bool("should_commit");
+  } catch (const std::exception& e) {
+    return Response{400, "application/json",
+                    std::string("{\"error\":\"") + e.what() + "\"}"};
+  }
+
+  std::unique_lock<std::mutex> lk(mu_);
+  if (!should_commit) commit_failures_.insert(rank);
+  commit_count_.insert(rank);
+  uint64_t seen = commit_seq_;
+
+  if (commit_count_.size() >= opts_.world_size) {
+    latest_decision_ = commit_failures_.empty();
+    commit_count_.clear();
+    commit_failures_.clear();
+    commit_seq_ += 1;
+    cv_.notify_all();
+  } else {
+    while (commit_seq_ == seen && !stopping_) {
+      auto deadline =
+          std::chrono::steady_clock::now() +
+          std::chrono::milliseconds(
+              std::max<int64_t>(1, req.deadline_ms - fthttp::now_ms()));
+      if (cv_.wait_until(lk, deadline) == std::cv_status::timeout &&
+          commit_seq_ == seen && fthttp::now_ms() >= req.deadline_ms) {
+        return Response{504, "application/json",
+                        "{\"error\":\"should_commit deadline exceeded\"}"};
+      }
+    }
+    if (stopping_) {
+      return Response{503, "application/json",
+                      "{\"error\":\"manager shutting down\"}"};
+    }
+  }
+
+  ftjson::Object out;
+  out["should_commit"] = latest_decision_;
+  return Response{200, "application/json", ftjson::Value(out).dump()};
+}
+
+Response ManagerServer::handle_kill(const Request& req) {
+  std::string msg;
+  try {
+    msg = ftjson::Value::parse(req.body).get_str("msg");
+  } catch (...) {
+  }
+  fprintf(stderr, "[torchft_tpu manager %s] got kill request: %s\n",
+          opts_.replica_id.c_str(), msg.c_str());
+  kill_requested_.store(true);
+  if (opts_.exit_on_kill) {
+    fflush(stderr);
+    _exit(1);
+  }
+  return Response{200, "application/json", "{}"};
+}
+
+}  // namespace ftmanager

@@ -1,0 +1,89 @@
+// torchft_tpu native control plane — per-replica-group Manager server.
+//
+// Embedded in the rank-0 Python trainer process of each replica group
+// (reference: /root/reference/src/manager.rs). Serves:
+//   POST /torchft.ManagerService/Quorum
+//   POST /torchft.ManagerService/CheckpointMetadata
+//   POST /torchft.ManagerService/ShouldCommit
+//   POST /torchft.ManagerService/Kill
+// and runs a heartbeat loop to the lighthouse.
+//
+// The Quorum RPC fans in all `world_size` local ranks, then issues ONE
+// lighthouse quorum request on behalf of the group and hands every local
+// waiter its own per-rank view via ftquorum::compute_quorum_results.
+// ShouldCommit is a two-phase barrier: all local ranks vote; the decision
+// (all-success) is broadcast and per-round state reset.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <map>
+#include <mutex>
+#include <optional>
+#include <set>
+#include <string>
+#include <thread>
+
+#include "httpx.h"
+#include "quorum.h"
+
+namespace ftmanager {
+
+struct ManagerOpts {
+  std::string replica_id;
+  std::string lighthouse_addr;  // http://host:port
+  std::string hostname = "127.0.0.1";
+  std::string bind_host = "0.0.0.0";
+  int port = 0;
+  std::string store_addr;
+  uint64_t world_size = 1;
+  uint64_t heartbeat_interval_ms = 100;
+  uint64_t connect_timeout_ms = 10000;
+  // When false, Kill sets a flag instead of exiting the process (tests).
+  bool exit_on_kill = true;
+};
+
+class ManagerServer {
+ public:
+  explicit ManagerServer(ManagerOpts opts);
+  ~ManagerServer();
+
+  // Probes the lighthouse (fails fast if unreachable, like the reference's
+  // eager client connect, manager.rs:97) then starts serving + heartbeats.
+  void start();
+  void shutdown();
+  std::string address() const;
+  int port() const { return server_.port(); }
+  bool kill_requested() const { return kill_requested_.load(); }
+
+ private:
+  fthttp::Response handle(const fthttp::Request& req);
+  fthttp::Response handle_quorum(const fthttp::Request& req);
+  fthttp::Response handle_checkpoint_metadata(const fthttp::Request& req);
+  fthttp::Response handle_should_commit(const fthttp::Request& req);
+  fthttp::Response handle_kill(const fthttp::Request& req);
+  void heartbeat_loop();
+
+  ManagerOpts opts_;
+  fthttp::HttpServer server_;
+  std::thread heartbeat_thread_;
+  std::atomic<bool> kill_requested_{false};
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool stopping_ = false;
+
+  // Quorum fan-in state.
+  std::map<int64_t, std::string> checkpoint_metadata_;
+  std::set<int64_t> participants_;
+  uint64_t quorum_seq_ = 0;
+  std::optional<ftquorum::QuorumInfo> latest_quorum_;
+
+  // ShouldCommit barrier state.
+  std::set<int64_t> commit_count_;
+  std::set<int64_t> commit_failures_;
+  uint64_t commit_seq_ = 0;
+  bool latest_decision_ = false;
+};
+
+}  // namespace ftmanager

@@ -1,0 +1,280 @@
+#include "quorum.h"
+
+#include <algorithm>
+#include <set>
+#include <sstream>
+#include <stdexcept>
+
+namespace ftquorum {
+
+ftjson::Value Member::to_json() const {
+  ftjson::Object o;
+  o["replica_id"] = replica_id;
+  o["address"] = address;
+  o["store_address"] = store_address;
+  o["step"] = step;
+  o["world_size"] = static_cast<int64_t>(world_size);
+  o["shrink_only"] = shrink_only;
+  return ftjson::Value(std::move(o));
+}
+
+Member Member::from_json(const ftjson::Value& v) {
+  Member m;
+  m.replica_id = v.get_str("replica_id");
+  m.address = v.get_str("address");
+  m.store_address = v.get_str("store_address");
+  m.step = v.get_int("step");
+  m.world_size = static_cast<uint64_t>(v.get_int("world_size", 1));
+  m.shrink_only = v.get_bool("shrink_only");
+  return m;
+}
+
+ftjson::Value QuorumInfo::to_json() const {
+  ftjson::Object o;
+  o["quorum_id"] = quorum_id;
+  ftjson::Array parts;
+  for (const auto& p : participants) parts.push_back(p.to_json());
+  o["participants"] = ftjson::Value(std::move(parts));
+  o["created_ms"] = created_ms;
+  return ftjson::Value(std::move(o));
+}
+
+QuorumInfo QuorumInfo::from_json(const ftjson::Value& v) {
+  QuorumInfo q;
+  q.quorum_id = v.get_int("quorum_id");
+  q.created_ms = v.get_int("created_ms");
+  for (const auto& p : v.get("participants").as_array()) {
+    q.participants.push_back(Member::from_json(p));
+  }
+  return q;
+}
+
+bool quorum_changed(const std::vector<Member>& a,
+                    const std::vector<Member>& b) {
+  if (a.size() != b.size()) return true;
+  for (size_t i = 0; i < a.size(); i++) {
+    if (a[i].replica_id != b[i].replica_id) return true;
+  }
+  return false;
+}
+
+QuorumDecision quorum_compute(int64_t now_ms, const QuorumState& state,
+                              const QuorumOpts& opts) {
+  // A replica is healthy iff its last heartbeat is fresh.
+  std::set<std::string> healthy_replicas;
+  for (const auto& hb : state.heartbeats) {
+    if (now_ms - hb.second <
+        static_cast<int64_t>(opts.heartbeat_timeout_ms)) {
+      healthy_replicas.insert(hb.first);
+    }
+  }
+
+  // Participants (replicas that actually requested a quorum) that are healthy.
+  std::vector<const ParticipantDetails*> healthy_participants;
+  for (const auto& kv : state.participants) {
+    if (healthy_replicas.count(kv.first)) {
+      healthy_participants.push_back(&kv.second);
+    }
+  }
+
+  std::vector<Member> candidates;
+  candidates.reserve(healthy_participants.size());
+  for (const auto* d : healthy_participants) candidates.push_back(d->member);
+  std::sort(candidates.begin(), candidates.end(),
+            [](const Member& a, const Member& b) {
+              return a.replica_id < b.replica_id;
+            });
+
+  bool shrink_only = false;
+  for (const auto* d : healthy_participants) {
+    if (d->member.shrink_only) shrink_only = true;
+  }
+
+  std::ostringstream meta;
+  meta << "[" << healthy_participants.size() << "/"
+       << state.participants.size() << " participants healthy]["
+       << healthy_replicas.size() << " heartbeating][shrink_only="
+       << (shrink_only ? "true" : "false") << "]";
+
+  if (state.prev_quorum.has_value()) {
+    const QuorumInfo& prev = *state.prev_quorum;
+    std::set<std::string> prev_ids;
+    for (const auto& p : prev.participants) prev_ids.insert(p.replica_id);
+
+    if (shrink_only) {
+      std::vector<Member> filtered;
+      for (auto& c : candidates) {
+        if (prev_ids.count(c.replica_id)) filtered.push_back(c);
+      }
+      candidates = std::move(filtered);
+    }
+
+    // Fast quorum: every member of the previous quorum is a healthy
+    // participant again, so no need to wait out the join timeout.
+    std::set<std::string> healthy_participant_ids;
+    for (const auto* d : healthy_participants) {
+      healthy_participant_ids.insert(d->member.replica_id);
+    }
+    bool is_fast = true;
+    for (const auto& p : prev.participants) {
+      if (!healthy_participant_ids.count(p.replica_id)) {
+        is_fast = false;
+        break;
+      }
+    }
+    if (is_fast) {
+      return {candidates, "Fast quorum found! " + meta.str()};
+    }
+  }
+
+  if (healthy_participants.size() < opts.min_replicas) {
+    std::ostringstream r;
+    r << "New quorum not ready, only have " << healthy_participants.size()
+      << " participants, need min_replicas " << opts.min_replicas << " "
+      << meta.str();
+    return {std::nullopt, r.str()};
+  }
+
+  // Split-brain guard: require a strict majority of the healthy heartbeaters
+  // to be participating before forming a quorum without them.
+  if (healthy_participants.size() <= healthy_replicas.size() / 2) {
+    std::ostringstream r;
+    r << "New quorum not ready, only have " << healthy_participants.size()
+      << " participants, need at least half of " << healthy_replicas.size()
+      << " healthy workers " << meta.str();
+    return {std::nullopt, r.str()};
+  }
+
+  bool all_healthy_joined =
+      healthy_participants.size() == healthy_replicas.size();
+  int64_t first_joined = now_ms;
+  for (const auto* d : healthy_participants) {
+    first_joined = std::min(first_joined, d->joined_ms);
+  }
+  if (!all_healthy_joined &&
+      now_ms - first_joined < static_cast<int64_t>(opts.join_timeout_ms)) {
+    std::ostringstream r;
+    r << "Valid quorum with " << healthy_participants.size()
+      << " participants, waiting for "
+      << (healthy_replicas.size() - healthy_participants.size())
+      << " healthy but not participating stragglers due to join timeout "
+      << meta.str();
+    return {std::nullopt, r.str()};
+  }
+
+  return {candidates, "Valid quorum found " + meta.str()};
+}
+
+ftjson::Value QuorumResults::to_json() const {
+  ftjson::Object o;
+  o["quorum_id"] = quorum_id;
+  o["recover_src_manager_address"] = recover_src_manager_address;
+  o["recover_src_rank"] = recover_src_rank.has_value()
+                              ? ftjson::Value(*recover_src_rank)
+                              : ftjson::Value(nullptr);
+  ftjson::Array dst;
+  for (int64_t r : recover_dst_ranks) dst.push_back(r);
+  o["recover_dst_ranks"] = ftjson::Value(std::move(dst));
+  o["store_address"] = store_address;
+  o["max_step"] = max_step;
+  o["max_rank"] = max_rank.has_value() ? ftjson::Value(*max_rank)
+                                       : ftjson::Value(nullptr);
+  o["max_world_size"] = max_world_size;
+  o["replica_rank"] = replica_rank;
+  o["replica_world_size"] = replica_world_size;
+  o["heal"] = heal;
+  return ftjson::Value(std::move(o));
+}
+
+QuorumResults compute_quorum_results(const std::string& replica_id,
+                                     int64_t rank, const QuorumInfo& quorum) {
+  std::vector<Member> participants = quorum.participants;
+  std::sort(participants.begin(), participants.end(),
+            [](const Member& a, const Member& b) {
+              return a.replica_id < b.replica_id;
+            });
+
+  int64_t replica_rank = -1;
+  for (size_t i = 0; i < participants.size(); i++) {
+    if (participants[i].replica_id == replica_id) {
+      replica_rank = static_cast<int64_t>(i);
+      break;
+    }
+  }
+  if (replica_rank < 0) {
+    throw std::runtime_error("replica " + replica_id +
+                             " not participating in returned quorum");
+  }
+
+  int64_t max_step = 0;
+  for (const auto& p : participants) max_step = std::max(max_step, p.step);
+
+  // Index list of the up-to-date ("max step") cohort.
+  std::vector<size_t> max_indices;
+  for (size_t i = 0; i < participants.size(); i++) {
+    if (participants[i].step == max_step) max_indices.push_back(i);
+  }
+
+  std::optional<int64_t> max_rank;
+  for (size_t mi = 0; mi < max_indices.size(); mi++) {
+    if (participants[max_indices[mi]].replica_id == replica_id) {
+      max_rank = static_cast<int64_t>(mi);
+      break;
+    }
+  }
+
+  // Primary store for this local rank, spread over the max-step cohort.
+  const Member& primary =
+      participants[max_indices[static_cast<size_t>(rank) %
+                               max_indices.size()]];
+
+  // Recovering replicas: behind max_step, or (step 0 bootstrap) everyone but
+  // the primary so that all replicas sync identical initial state.
+  std::vector<size_t> recover_dst;
+  std::set<size_t> recover_dst_set;
+  for (size_t i = 0; i < participants.size(); i++) {
+    if (participants[i].step != max_step ||
+        (max_step == 0 && primary.replica_id != participants[i].replica_id)) {
+      recover_dst.push_back(i);
+      recover_dst_set.insert(i);
+    }
+  }
+  std::vector<size_t> up_to_date;
+  for (size_t i = 0; i < participants.size(); i++) {
+    if (!recover_dst_set.count(i)) up_to_date.push_back(i);
+  }
+
+  // Round-robin recovering→source assignment, offset by the local rank so
+  // that different local ranks of the same healing replica pull from
+  // different donor replicas.
+  std::map<size_t, std::vector<int64_t>> assignments;
+  std::optional<int64_t> recover_src_rank;
+  for (size_t i = 0; i < recover_dst.size(); i++) {
+    size_t src =
+        up_to_date[(i + static_cast<size_t>(rank)) % up_to_date.size()];
+    assignments[src].push_back(static_cast<int64_t>(recover_dst[i]));
+    if (static_cast<int64_t>(recover_dst[i]) == replica_rank) {
+      recover_src_rank = static_cast<int64_t>(src);
+    }
+  }
+
+  QuorumResults out;
+  out.quorum_id = quorum.quorum_id;
+  out.recover_src_rank = recover_src_rank;
+  out.heal = recover_src_rank.has_value();
+  if (recover_src_rank.has_value()) {
+    out.recover_src_manager_address =
+        participants[static_cast<size_t>(*recover_src_rank)].address;
+  }
+  auto it = assignments.find(static_cast<size_t>(replica_rank));
+  if (it != assignments.end()) out.recover_dst_ranks = it->second;
+  out.store_address = primary.store_address;
+  out.max_step = max_step;
+  out.max_rank = max_rank;
+  out.max_world_size = static_cast<int64_t>(max_indices.size());
+  out.replica_rank = replica_rank;
+  out.replica_world_size = static_cast<int64_t>(participants.size());
+  return out;
+}
+
+}  // namespace ftquorum

@@ -1,0 +1,103 @@
+// torchft_tpu native control plane — pure quorum decision kernels.
+//
+// Semantics match the reference's decision logic (quorum_compute at
+// /root/reference/src/lighthouse.rs:113-241, compute_quorum_results at
+// /root/reference/src/manager.rs:357-480) but are a fresh C++ design:
+// the kernels are pure functions over value types so they can be unit-tested
+// (from Python via the C API) without any server running.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "ftjson.h"
+
+namespace ftquorum {
+
+// proto/torchft_tpu.proto QuorumMember.
+struct Member {
+  std::string replica_id;
+  std::string address;        // manager control address (http://host:port)
+  std::string store_address;  // rendezvous store address
+  int64_t step = 0;
+  uint64_t world_size = 1;
+  bool shrink_only = false;
+
+  ftjson::Value to_json() const;
+  static Member from_json(const ftjson::Value& v);
+};
+
+struct QuorumInfo {
+  int64_t quorum_id = 0;
+  std::vector<Member> participants;
+  int64_t created_ms = 0;  // wall-clock epoch millis
+
+  ftjson::Value to_json() const;
+  static QuorumInfo from_json(const ftjson::Value& v);
+};
+
+struct ParticipantDetails {
+  int64_t joined_ms = 0;  // monotonic ms when the replica requested quorum
+  Member member;
+};
+
+// Inputs to the quorum decision, extracted from lighthouse state.
+struct QuorumState {
+  std::map<std::string, ParticipantDetails> participants;
+  std::map<std::string, int64_t> heartbeats;  // replica_id -> monotonic ms
+  std::optional<QuorumInfo> prev_quorum;
+};
+
+struct QuorumOpts {
+  uint64_t min_replicas = 1;
+  uint64_t join_timeout_ms = 60000;
+  uint64_t quorum_tick_ms = 100;
+  uint64_t heartbeat_timeout_ms = 5000;
+};
+
+struct QuorumDecision {
+  std::optional<std::vector<Member>> quorum;  // nullopt = not ready
+  std::string reason;
+};
+
+// Membership (replica-id set) comparison: a quorum "changed" only when the
+// ordered id list differs (ref lighthouse.rs:105-110).
+bool quorum_changed(const std::vector<Member>& a, const std::vector<Member>& b);
+
+// The decision kernel. Healthy = heartbeat younger than heartbeat_timeout;
+// fast-quorum when every prev-quorum member is a healthy participant;
+// min_replicas floor; split-brain guard (participants must exceed half the
+// healthy heartbeaters); join timeout holds the quorum open for healthy
+// stragglers; shrink_only drops non-prev-members from the candidate set.
+QuorumDecision quorum_compute(int64_t now_ms, const QuorumState& state,
+                              const QuorumOpts& opts);
+
+// Per-rank view of an announced quorum (proto ManagerQuorumResponse).
+struct QuorumResults {
+  int64_t quorum_id = 0;
+  std::string recover_src_manager_address;
+  std::optional<int64_t> recover_src_rank;
+  std::vector<int64_t> recover_dst_ranks;
+  std::string store_address;
+  int64_t max_step = 0;
+  std::optional<int64_t> max_rank;
+  int64_t max_world_size = 0;
+  int64_t replica_rank = 0;
+  int64_t replica_world_size = 0;
+  bool heal = false;
+
+  ftjson::Value to_json() const;
+};
+
+// Recovery-assignment kernel: sorts participants by replica_id, derives the
+// caller's replica_rank, the max-step cohort, the primary store, and the
+// round-robin mapping of recovering replicas onto up-to-date sources offset
+// by the caller's local rank (so different local ranks pull from different
+// donors). Throws std::runtime_error if replica_id is absent from quorum.
+QuorumResults compute_quorum_results(const std::string& replica_id,
+                                     int64_t rank, const QuorumInfo& quorum);
+
+}  // namespace ftquorum

@@ -1,0 +1,289 @@
+"""End-to-end tests for the native lighthouse + manager servers.
+
+Mirrors the reference's server-level tests (lighthouse.rs:912-954 e2e quorum,
+manager.rs:504-718 should_commit voting / quorum / checkpoint metadata,
+lighthouse_test.py timing bound) over real HTTP on localhost.
+"""
+
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from torchft_tpu.control import (
+    Lighthouse,
+    ManagerClient,
+    ManagerServer,
+    lighthouse_heartbeat,
+    lighthouse_quorum,
+)
+
+
+@pytest.fixture()
+def lighthouse():
+    lh = Lighthouse(min_replicas=1, join_timeout_ms=100)
+    yield lh
+    lh.shutdown()
+
+
+def _make_manager(lighthouse, replica_id="rep_0", world_size=1, **kwargs):
+    return ManagerServer(
+        replica_id=replica_id,
+        lighthouse_addr=lighthouse.address(),
+        store_addr=f"store:{replica_id}",
+        world_size=world_size,
+        exit_on_kill=False,
+        **kwargs,
+    )
+
+
+def test_lighthouse_address(lighthouse) -> None:
+    addr = lighthouse.address()
+    assert addr.startswith("http://")
+
+
+def test_lighthouse_quorum_join_timing(lighthouse) -> None:
+    # Single replica quorum resolves well under 0.4s with 100ms join timeout
+    # (parity with ref lighthouse_test.py:44-47).
+    start = time.monotonic()
+    result = lighthouse_quorum(
+        lighthouse.address(),
+        {
+            "replica_id": "timing",
+            "address": "addr",
+            "store_address": "store",
+            "step": 0,
+            "world_size": 1,
+            "shrink_only": False,
+        },
+        timeout=5.0,
+    )
+    elapsed = time.monotonic() - start
+    assert elapsed < 0.4, f"quorum took {elapsed}s"
+    ids = [p["replica_id"] for p in result["quorum"]["participants"]]
+    assert ids == ["timing"]
+
+
+def test_lighthouse_heartbeat(lighthouse) -> None:
+    lighthouse_heartbeat(lighthouse.address(), "hb_rep")
+
+
+def test_lighthouse_dashboard(lighthouse) -> None:
+    addr = lighthouse.address()
+    html = urllib.request.urlopen(addr + "/", timeout=5).read().decode()
+    assert "lighthouse" in html
+    status = urllib.request.urlopen(addr + "/status", timeout=5).read().decode()
+    assert "quorum" in status
+
+
+def test_manager_single_replica_quorum(lighthouse) -> None:
+    mgr = _make_manager(lighthouse, "rep_0")
+    try:
+        client = ManagerClient(mgr.address())
+        result = client.quorum(
+            rank=0, step=0, checkpoint_metadata="ckpt0", shrink_only=False,
+            timeout=10.0,
+        )
+        assert result.quorum_id >= 1
+        assert result.replica_rank == 0
+        assert result.replica_world_size == 1
+        assert result.max_step == 0
+        assert not result.heal  # sole replica is the primary at step 0
+        assert result.store_address == "store:rep_0"
+    finally:
+        mgr.shutdown()
+
+
+def test_manager_two_replica_quorum_and_heal_assignment() -> None:
+    # Two replica groups at different steps: behind group must heal from the
+    # up-to-date one (ref manager.rs:551-671 semantics).
+    lh = Lighthouse(min_replicas=2, join_timeout_ms=200)
+    mgr_a = None
+    mgr_b = None
+    try:
+        mgr_a = _make_manager(lh, "rep_a")
+        mgr_b = _make_manager(lh, "rep_b")
+        client_a = ManagerClient(mgr_a.address())
+        client_b = ManagerClient(mgr_b.address())
+
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            fut_a = pool.submit(
+                client_a.quorum, 0, 10, "ckpt_a", False, 10.0
+            )
+            fut_b = pool.submit(
+                client_b.quorum, 0, 4, "ckpt_b", False, 10.0
+            )
+            res_a = fut_a.result(timeout=15)
+            res_b = fut_b.result(timeout=15)
+
+        assert res_a.quorum_id == res_b.quorum_id
+        assert res_a.replica_world_size == 2
+        assert res_a.max_step == 10
+        assert not res_a.heal
+        assert res_a.recover_dst_ranks == [1]  # rep_b sorts after rep_a
+        assert res_b.heal
+        assert res_b.recover_src_rank == 0
+        assert res_b.recover_src_manager_address == mgr_a.address()
+        assert res_b.max_rank is None
+        assert res_b.replica_rank == 1
+    finally:
+        if mgr_a:
+            mgr_a.shutdown()
+        if mgr_b:
+            mgr_b.shutdown()
+        lh.shutdown()
+
+
+def test_manager_local_fanin_two_ranks(lighthouse) -> None:
+    # world_size=2: the manager waits for BOTH local ranks before issuing
+    # one lighthouse request on behalf of the group.
+    mgr = _make_manager(lighthouse, "rep_0", world_size=2)
+    try:
+        client0 = ManagerClient(mgr.address())
+        client1 = ManagerClient(mgr.address())
+
+        results = {}
+
+        def _quorum(rank, client):
+            results[rank] = client.quorum(rank, 7, f"meta{rank}", False, 10.0)
+
+        t0 = threading.Thread(target=_quorum, args=(0, client0))
+        t0.start()
+        time.sleep(0.2)
+        assert not results, "rank 0 must block until rank 1 joins"
+        t1 = threading.Thread(target=_quorum, args=(1, client1))
+        t1.start()
+        t0.join(timeout=10)
+        t1.join(timeout=10)
+        assert results[0].quorum_id == results[1].quorum_id
+        assert results[0].replica_world_size == 1  # one replica group
+    finally:
+        mgr.shutdown()
+
+
+def test_should_commit_unanimous_and_veto(lighthouse) -> None:
+    # Two-phase commit barrier over 2 local ranks (ref manager.rs:504-549).
+    mgr = _make_manager(lighthouse, "rep_0", world_size=2)
+    try:
+        c0 = ManagerClient(mgr.address())
+        c1 = ManagerClient(mgr.address())
+
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            f0 = pool.submit(c0.should_commit, 0, 1, True, 10.0)
+            f1 = pool.submit(c1.should_commit, 1, 1, True, 10.0)
+            assert f0.result(timeout=15) is True
+            assert f1.result(timeout=15) is True
+
+            # Round 2: one rank votes False -> everyone aborts.
+            f0 = pool.submit(c0.should_commit, 0, 2, True, 10.0)
+            f1 = pool.submit(c1.should_commit, 1, 2, False, 10.0)
+            assert f0.result(timeout=15) is False
+            assert f1.result(timeout=15) is False
+
+            # Round 3: state reset -> True again.
+            f0 = pool.submit(c0.should_commit, 0, 3, True, 10.0)
+            f1 = pool.submit(c1.should_commit, 1, 3, True, 10.0)
+            assert f0.result(timeout=15) is True
+            assert f1.result(timeout=15) is True
+    finally:
+        mgr.shutdown()
+
+
+def test_checkpoint_metadata_roundtrip(lighthouse) -> None:
+    mgr = _make_manager(lighthouse, "rep_0")
+    try:
+        client = ManagerClient(mgr.address())
+        with pytest.raises(RuntimeError, match="rank not found"):
+            client.checkpoint_metadata(0, timeout=5.0)
+        client.quorum(0, 0, "the-metadata", False, 10.0)
+        assert client.checkpoint_metadata(0, timeout=5.0) == "the-metadata"
+    finally:
+        mgr.shutdown()
+
+
+def test_quorum_timeout_is_bounded(lighthouse) -> None:
+    # A quorum that cannot complete (world_size=2, only one rank calls) must
+    # raise TimeoutError within ~the requested timeout, not hang
+    # (ref manager_integ_test.py:653-665 bound <1.0s).
+    mgr = _make_manager(lighthouse, "rep_0", world_size=2)
+    try:
+        client = ManagerClient(mgr.address())
+        start = time.monotonic()
+        with pytest.raises(TimeoutError):
+            client.quorum(0, 0, "", False, timeout=0.3)
+        assert time.monotonic() - start < 1.0
+    finally:
+        mgr.shutdown()
+
+
+def test_should_commit_timeout_is_bounded(lighthouse) -> None:
+    mgr = _make_manager(lighthouse, "rep_0", world_size=2)
+    try:
+        client = ManagerClient(mgr.address())
+        start = time.monotonic()
+        with pytest.raises(TimeoutError):
+            client.should_commit(0, 0, True, timeout=0.3)
+        assert time.monotonic() - start < 1.0
+    finally:
+        mgr.shutdown()
+
+
+def test_kill_rpc_sets_flag(lighthouse) -> None:
+    mgr = _make_manager(lighthouse, "rep_0")
+    try:
+        client = ManagerClient(mgr.address())
+        assert not mgr.kill_requested()
+        client.kill("test kill")
+        deadline = time.monotonic() + 5
+        while not mgr.kill_requested() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert mgr.kill_requested()
+    finally:
+        mgr.shutdown()
+
+
+def test_dashboard_kill_button_path(lighthouse) -> None:
+    # POST /replica/{id}/kill proxies to that replica's manager Kill RPC
+    # (ref lighthouse.rs:414-439).
+    mgr = _make_manager(lighthouse, "rep_k")
+    try:
+        client = ManagerClient(mgr.address())
+        client.quorum(0, 0, "", False, 10.0)  # register in a quorum
+        req = urllib.request.Request(
+            lighthouse.address() + "/replica/rep_k/kill", method="POST"
+        )
+        urllib.request.urlopen(req, timeout=10)
+        deadline = time.monotonic() + 5
+        while not mgr.kill_requested() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert mgr.kill_requested()
+    finally:
+        mgr.shutdown()
+
+
+def test_manager_unreachable_lighthouse_fails_fast() -> None:
+    start = time.monotonic()
+    with pytest.raises((RuntimeError, TimeoutError)):
+        ManagerServer(
+            replica_id="r",
+            lighthouse_addr="http://127.0.0.1:1",  # nothing listening
+            world_size=1,
+            connect_timeout=0.3,
+        )
+    assert time.monotonic() - start < 3.0
+
+
+def test_repeated_quorums_stable_id(lighthouse) -> None:
+    # Same membership across rounds -> quorum_id stays put; the id only
+    # bumps on membership change (ref lighthouse.rs:272-283).
+    mgr = _make_manager(lighthouse, "rep_0")
+    try:
+        client = ManagerClient(mgr.address())
+        first = client.quorum(0, 1, "", False, 10.0)
+        second = client.quorum(0, 2, "", False, 10.0)
+        third = client.quorum(0, 3, "", False, 10.0)
+        assert first.quorum_id == second.quorum_id == third.quorum_id
+    finally:
+        mgr.shutdown()

@@ -1,0 +1,275 @@
+"""Pure quorum-kernel tests against the native C++ library.
+
+Coverage mirrors the reference's in-file Rust test matrices
+(lighthouse.rs:584-1037 quorum_compute scenarios; manager.rs:720-850
+compute_quorum_results matrices), driven from Python through the C API.
+"""
+
+import ctypes
+import json
+
+import pytest
+
+from torchft_tpu.control._native import check_error, get_lib, take_string
+
+
+def member(replica_id, step=0, world_size=1, shrink_only=False):
+    return {
+        "replica_id": replica_id,
+        "address": f"addr_{replica_id}",
+        "store_address": f"store_addr_{replica_id}",
+        "step": step,
+        "world_size": world_size,
+        "shrink_only": shrink_only,
+    }
+
+
+def quorum_compute(now_ms, participants, heartbeats, prev_quorum, opts):
+    """participants: list of (joined_ms, member); heartbeats: {id: ms}."""
+    lib = get_lib()
+    state = {
+        "participants": [
+            {"joined_ms": j, "member": m} for j, m in participants
+        ],
+        "heartbeats": heartbeats,
+        "prev_quorum": prev_quorum,
+    }
+    err = ctypes.c_char_p()
+    ptr = lib.ft_quorum_compute(
+        now_ms,
+        json.dumps(state).encode(),
+        json.dumps(opts).encode(),
+        ctypes.byref(err),
+    )
+    check_error(err)
+    out = json.loads(take_string(ptr))
+    return out["quorum"], out["reason"]
+
+
+def compute_quorum_results(replica_id, rank, participants, quorum_id=1):
+    lib = get_lib()
+    q = {"quorum_id": quorum_id, "participants": participants, "created_ms": 0}
+    err = ctypes.c_char_p()
+    ptr = lib.ft_compute_quorum_results(
+        replica_id.encode(), rank, json.dumps(q).encode(), ctypes.byref(err)
+    )
+    check_error(err)
+    return json.loads(take_string(ptr))
+
+
+OPTS = {"min_replicas": 1, "join_timeout_ms": 60000, "heartbeat_timeout_ms": 5000}
+
+
+def test_json_roundtrip() -> None:
+    lib = get_lib()
+    cases = [
+        '{"a":1,"b":[true,false,null],"c":"x\\ny","d":-3.5}',
+        '{"nested":{"deep":{"n":9223372036854775807}}}',
+        '{"uni":"\\u00e9\\u4e2d"}',
+        "[]",
+    ]
+    for c in cases:
+        err = ctypes.c_char_p()
+        out = take_string(lib.ft_json_roundtrip(c.encode(), ctypes.byref(err)))
+        check_error(err)
+        assert json.loads(out) == json.loads(c)
+
+
+def test_empty_state_no_quorum() -> None:
+    q, reason = quorum_compute(1000, [], {}, None, OPTS)
+    assert q is None
+    assert "min_replicas" in reason
+
+
+def test_basic_quorum_all_joined() -> None:
+    # Both heartbeating replicas joined -> quorum without join timeout wait.
+    participants = [(100, member("a")), (100, member("b"))]
+    heartbeats = {"a": 900, "b": 900}
+    q, reason = quorum_compute(1000, participants, heartbeats, None, OPTS)
+    assert q is not None
+    assert [m["replica_id"] for m in q] == ["a", "b"]
+    assert "Valid quorum" in reason
+
+
+def test_join_timeout_holds_for_stragglers() -> None:
+    # "c" heartbeats but hasn't joined; quorum waits out join_timeout_ms
+    # (ref lighthouse.rs:584-657).
+    participants = [(100, member("a")), (100, member("b"))]
+    heartbeats = {"a": 900, "b": 900, "c": 900}
+    q, reason = quorum_compute(1000, participants, heartbeats, None, OPTS)
+    assert q is None
+    assert "stragglers" in reason
+
+    # After join timeout expires (first_joined=100, now > 100+60000): proceed
+    # without the straggler (2 of 3 also satisfies the split-brain guard).
+    heartbeats = {"a": 69900, "b": 69900, "c": 69900}
+    q, reason = quorum_compute(70000, participants, heartbeats, None, OPTS)
+    assert q is not None
+    assert [m["replica_id"] for m in q] == ["a", "b"]
+
+
+def test_heartbeat_expiry_excludes_replica() -> None:
+    # "b" joined but its heartbeat is stale (ref lighthouse.rs:659-739).
+    participants = [(100, member("a")), (100, member("b"))]
+    heartbeats = {"a": 99000, "b": 1000}
+    q, _ = quorum_compute(100000, participants, heartbeats, None, OPTS)
+    assert q is not None
+    assert [m["replica_id"] for m in q] == ["a"]
+
+
+def test_min_replicas_floor() -> None:
+    opts = dict(OPTS, min_replicas=2)
+    participants = [(100, member("a"))]
+    q, reason = quorum_compute(1000, participants, {"a": 900}, None, opts)
+    assert q is None
+    assert "min_replicas" in reason
+
+
+def test_fast_quorum_skips_join_timeout() -> None:
+    # All prev-quorum members healthy + joined => no join-timeout wait even
+    # though a new healthy replica hasn't joined (ref lighthouse.rs:741-823).
+    prev = {
+        "quorum_id": 1,
+        "participants": [member("a"), member("b")],
+        "created_ms": 0,
+    }
+    participants = [(100, member("a")), (100, member("b"))]
+    heartbeats = {"a": 900, "b": 900, "c": 900}  # "c" healthy, not joined
+    q, reason = quorum_compute(1000, participants, heartbeats, prev, OPTS)
+    assert q is not None
+    assert "Fast quorum" in reason
+    assert [m["replica_id"] for m in q] == ["a", "b"]
+
+
+def test_fast_quorum_includes_new_joiner() -> None:
+    # Fast quorum returns ALL healthy participants, including new joiners.
+    prev = {
+        "quorum_id": 1,
+        "participants": [member("a")],
+        "created_ms": 0,
+    }
+    participants = [(100, member("a")), (100, member("c"))]
+    heartbeats = {"a": 900, "c": 900}
+    q, reason = quorum_compute(1000, participants, heartbeats, prev, OPTS)
+    assert q is not None
+    assert "Fast quorum" in reason
+    assert [m["replica_id"] for m in q] == ["a", "c"]
+
+
+def test_shrink_only_restricts_to_prev_members() -> None:
+    # shrink_only drops non-prev-members from candidates
+    # (ref lighthouse.rs:825-910).
+    prev = {
+        "quorum_id": 1,
+        "participants": [member("a"), member("b")],
+        "created_ms": 0,
+    }
+    participants = [
+        (100, member("a", shrink_only=True)),
+        (100, member("b")),
+        (100, member("c")),  # new joiner, must be excluded
+    ]
+    heartbeats = {"a": 900, "b": 900, "c": 900}
+    q, _ = quorum_compute(1000, participants, heartbeats, prev, OPTS)
+    assert q is not None
+    assert [m["replica_id"] for m in q] == ["a", "b"]
+
+
+def test_split_brain_guard() -> None:
+    # 1 participant of 3 healthy heartbeaters: 1 <= 3/2 -> blocked
+    # (ref lighthouse.rs:956-1003). Join timeout already expired.
+    participants = [(100, member("a"))]
+    heartbeats = {"a": 99000, "b": 99000, "c": 99000}
+    q, reason = quorum_compute(100000, participants, heartbeats, None, OPTS)
+    assert q is None
+    assert "half" in reason
+
+    # 2 of 3: 2 > 3/2=1 -> allowed once join timeout passes.
+    participants = [(100, member("a")), (100, member("b"))]
+    q, _ = quorum_compute(100000, participants, heartbeats, None, OPTS)
+    assert q is not None
+
+
+def test_compute_results_first_step() -> None:
+    # Port of manager.rs:720-768: at step 0 everyone but the primary heals.
+    parts = [member("replica_0", step=0), member("replica_1", step=0)]
+
+    r = compute_quorum_results("replica_0", 0, parts)
+    assert not r["heal"]
+    assert r["replica_rank"] == 0
+    assert r["recover_src_rank"] is None
+    assert r["recover_dst_ranks"] == [1]
+
+    r = compute_quorum_results("replica_1", 0, parts)
+    assert r["heal"]
+    assert r["replica_rank"] == 1
+    assert r["recover_src_rank"] == 0
+    assert r["recover_dst_ranks"] == []
+
+    # local rank 1 assignments are offset from rank 0's.
+    r = compute_quorum_results("replica_1", 1, parts)
+    assert not r["heal"]
+    assert r["replica_rank"] == 1
+    assert r["recover_src_rank"] is None
+    assert r["recover_dst_ranks"] == [0]
+
+
+def test_compute_results_mixed_step_recovery() -> None:
+    # Port of manager.rs:770-850: replicas 1,3 at step 1; 0,2,4 behind.
+    parts = [
+        member("replica_0", step=0),
+        member("replica_1", step=1),
+        member("replica_2", step=0),
+        member("replica_3", step=1),
+        member("replica_4", step=0),
+    ]
+
+    r = compute_quorum_results("replica_0", 0, parts)
+    assert r["heal"]
+    assert r["recover_src_manager_address"] == "addr_replica_1"
+    assert r["replica_rank"] == 0
+    assert r["recover_src_rank"] == 1
+    assert r["recover_dst_ranks"] == []
+
+    r = compute_quorum_results("replica_1", 0, parts)
+    assert not r["heal"]
+    assert r["recover_src_manager_address"] == ""
+    assert r["replica_rank"] == 1
+    assert r["recover_src_rank"] is None
+    assert r["recover_dst_ranks"] == [0, 4]
+
+    r = compute_quorum_results("replica_3", 0, parts)
+    assert not r["heal"]
+    assert r["replica_rank"] == 3
+    assert r["recover_src_rank"] is None
+    assert r["recover_dst_ranks"] == [2]
+
+    # local rank 1: assignments rotate by one donor.
+    r = compute_quorum_results("replica_1", 1, parts)
+    assert not r["heal"]
+    assert r["replica_rank"] == 1
+    assert r["recover_src_rank"] is None
+    assert r["recover_dst_ranks"] == [2]
+
+
+def test_compute_results_max_cohort_fields() -> None:
+    parts = [
+        member("replica_0", step=5),
+        member("replica_1", step=3),
+        member("replica_2", step=5),
+    ]
+    r = compute_quorum_results("replica_2", 0, parts)
+    assert r["max_step"] == 5
+    assert r["max_world_size"] == 2
+    assert r["max_rank"] == 1  # index within the max-step cohort
+    assert r["replica_world_size"] == 3
+
+    r = compute_quorum_results("replica_1", 0, parts)
+    assert r["max_rank"] is None
+    assert r["heal"]
+
+
+def test_compute_results_missing_replica_raises() -> None:
+    parts = [member("replica_0", step=0)]
+    with pytest.raises(RuntimeError, match="not participating"):
+        compute_quorum_results("ghost", 0, parts)

@@ -1,0 +1,295 @@
+"""Python surface of the native control plane.
+
+API parity target: the reference pyo3 classes in
+/root/reference/torchft/torchft.pyi (Manager/ManagerClient/Lighthouse/
+QuorumResult). Server objects own native threads; every RPC call releases
+the GIL for its full duration (ctypes calls drop the GIL).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+from dataclasses import dataclass, field
+from datetime import timedelta
+from typing import List, Optional
+
+from torchft_tpu.control._native import check_error, get_lib, take_string
+
+__all__ = [
+    "Lighthouse",
+    "ManagerServer",
+    "ManagerClient",
+    "QuorumResult",
+    "lighthouse_heartbeat",
+    "lighthouse_quorum",
+]
+
+
+def _ms(t: "float | timedelta", default_ms: int = 60000) -> int:
+    if t is None:
+        return default_ms
+    if isinstance(t, timedelta):
+        return max(1, int(t.total_seconds() * 1000))
+    return max(1, int(float(t) * 1000))
+
+
+def _split_bind(bind: str) -> "tuple[str, int]":
+    """Accept 'host:port', ':port', '[::]:port'."""
+    host, _, port = bind.rpartition(":")
+    if host in ("", "[::]", "::"):
+        host = "0.0.0.0"
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+    return host, int(port or "0")
+
+
+@dataclass
+class QuorumResult:
+    """Per-rank quorum view (proto ManagerQuorumResponse; ref torchft.pyi:23-34)."""
+
+    quorum_id: int = 0
+    replica_rank: int = 0
+    replica_world_size: int = 1
+    recover_src_manager_address: str = ""
+    recover_src_rank: Optional[int] = None
+    recover_dst_ranks: List[int] = field(default_factory=list)
+    store_address: str = ""
+    max_step: int = 0
+    max_rank: Optional[int] = None
+    max_world_size: int = 1
+    heal: bool = False
+
+    @staticmethod
+    def from_json(payload: str) -> "QuorumResult":
+        d = json.loads(payload)
+        return QuorumResult(
+            quorum_id=d["quorum_id"],
+            replica_rank=d["replica_rank"],
+            replica_world_size=d["replica_world_size"],
+            recover_src_manager_address=d["recover_src_manager_address"],
+            recover_src_rank=d.get("recover_src_rank"),
+            recover_dst_ranks=list(d.get("recover_dst_ranks") or []),
+            store_address=d["store_address"],
+            max_step=d["max_step"],
+            max_rank=d.get("max_rank"),
+            max_world_size=d["max_world_size"],
+            heal=d["heal"],
+        )
+
+
+class Lighthouse:
+    """In-process lighthouse server (ref lib.rs:266-319 pyclass).
+
+    Note the embedded default join_timeout_ms=100 matches the reference
+    pyclass default (lib.rs:285); the CLI default is 60000.
+    """
+
+    def __init__(
+        self,
+        bind: str = "0.0.0.0:0",
+        min_replicas: int = 1,
+        join_timeout_ms: Optional[int] = None,
+        quorum_tick_ms: Optional[int] = None,
+        heartbeat_timeout_ms: Optional[int] = None,
+        hostname: str = "127.0.0.1",
+    ) -> None:
+        host, port = _split_bind(bind)
+        lib = get_lib()
+        err = ctypes.c_char_p()
+        self._handle = lib.ft_lighthouse_new(
+            host.encode(),
+            port,
+            hostname.encode(),
+            min_replicas,
+            join_timeout_ms if join_timeout_ms is not None else 100,
+            quorum_tick_ms if quorum_tick_ms is not None else 100,
+            heartbeat_timeout_ms if heartbeat_timeout_ms is not None else 5000,
+            ctypes.byref(err),
+        )
+        check_error(err)
+        if not self._handle:
+            raise RuntimeError("failed to create lighthouse")
+
+    def address(self) -> str:
+        return take_string(get_lib().ft_lighthouse_address(self._handle))
+
+    def shutdown(self) -> None:
+        if self._handle:
+            get_lib().ft_lighthouse_shutdown(self._handle)
+
+    def __del__(self) -> None:
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle:
+            get_lib().ft_lighthouse_free(handle)
+
+
+class ManagerServer:
+    """Native per-replica-group manager server, embedded in the rank-0
+    trainer process (ref lib.rs:33-86 `Manager` pyclass)."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        lighthouse_addr: str,
+        hostname: Optional[str] = None,
+        bind: str = "0.0.0.0:0",
+        store_addr: str = "",
+        world_size: int = 1,
+        heartbeat_interval: "float | timedelta" = 0.1,
+        connect_timeout: "float | timedelta" = 10.0,
+        exit_on_kill: bool = True,
+    ) -> None:
+        if hostname is None:
+            # The advertised address crosses hosts (it becomes peers'
+            # recover_src_manager_address), so default to the machine
+            # hostname, not loopback — unless it doesn't resolve locally.
+            import socket as _socket
+
+            hostname = _socket.gethostname()
+            try:
+                _socket.getaddrinfo(hostname, None)
+            except OSError:
+                hostname = "127.0.0.1"
+        host, port = _split_bind(bind)
+        lib = get_lib()
+        err = ctypes.c_char_p()
+        self._handle = lib.ft_manager_new(
+            replica_id.encode(),
+            lighthouse_addr.encode(),
+            hostname.encode(),
+            host.encode(),
+            port,
+            store_addr.encode(),
+            world_size,
+            _ms(heartbeat_interval, 100),
+            _ms(connect_timeout, 10000),
+            1 if exit_on_kill else 0,
+            ctypes.byref(err),
+        )
+        check_error(err)
+        if not self._handle:
+            raise RuntimeError("failed to create manager server")
+
+    def address(self) -> str:
+        return take_string(get_lib().ft_manager_address(self._handle))
+
+    def kill_requested(self) -> bool:
+        return bool(get_lib().ft_manager_kill_requested(self._handle))
+
+    def shutdown(self) -> None:
+        if self._handle:
+            get_lib().ft_manager_shutdown(self._handle)
+
+    def __del__(self) -> None:
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle:
+            get_lib().ft_manager_free(handle)
+
+
+class ManagerClient:
+    """Blocking client to a ManagerServer (ref lib.rs:88-197; API shape
+    torchft.pyi:4-21). Every call carries an explicit timeout that is also
+    enforced server-side via the x-timeout-ms header."""
+
+    def __init__(
+        self, addr: str, connect_timeout: "float | timedelta" = 10.0
+    ) -> None:
+        lib = get_lib()
+        err = ctypes.c_char_p()
+        self._handle = lib.ft_manager_client_new(
+            addr.encode(), _ms(connect_timeout, 10000), ctypes.byref(err)
+        )
+        check_error(err)
+        if not self._handle:
+            raise RuntimeError("failed to create manager client")
+
+    def quorum(
+        self,
+        rank: int,
+        step: int,
+        checkpoint_metadata: str,
+        shrink_only: bool,
+        timeout: "float | timedelta",
+    ) -> QuorumResult:
+        err = ctypes.c_char_p()
+        ptr = get_lib().ft_manager_client_quorum(
+            self._handle,
+            rank,
+            step,
+            checkpoint_metadata.encode(),
+            1 if shrink_only else 0,
+            _ms(timeout),
+            ctypes.byref(err),
+        )
+        check_error(err)
+        return QuorumResult.from_json(take_string(ptr))
+
+    def checkpoint_metadata(
+        self, rank: int, timeout: "float | timedelta"
+    ) -> str:
+        err = ctypes.c_char_p()
+        ptr = get_lib().ft_manager_client_checkpoint_metadata(
+            self._handle, rank, _ms(timeout), ctypes.byref(err)
+        )
+        check_error(err)
+        return take_string(ptr)
+
+    def should_commit(
+        self,
+        rank: int,
+        step: int,
+        should_commit: bool,
+        timeout: "float | timedelta",
+    ) -> bool:
+        err = ctypes.c_char_p()
+        result = get_lib().ft_manager_client_should_commit(
+            self._handle,
+            rank,
+            step,
+            1 if should_commit else 0,
+            _ms(timeout),
+            ctypes.byref(err),
+        )
+        check_error(err)
+        return result == 1
+
+    def kill(self, msg: str = "", timeout: "float | timedelta" = 10.0) -> None:
+        err = ctypes.c_char_p()
+        get_lib().ft_manager_client_kill(
+            self._handle, msg.encode(), _ms(timeout), ctypes.byref(err)
+        )
+        check_error(err)
+
+    def __del__(self) -> None:
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle:
+            get_lib().ft_manager_client_free(handle)
+
+
+def lighthouse_heartbeat(
+    lighthouse_addr: str, replica_id: str, timeout: "float | timedelta" = 5.0
+) -> None:
+    err = ctypes.c_char_p()
+    get_lib().ft_lighthouse_client_heartbeat(
+        lighthouse_addr.encode(), replica_id.encode(), _ms(timeout),
+        ctypes.byref(err),
+    )
+    check_error(err)
+
+
+def lighthouse_quorum(
+    lighthouse_addr: str,
+    requester: dict,
+    timeout: "float | timedelta" = 60.0,
+) -> dict:
+    """Direct lighthouse quorum RPC (used by tests/tools)."""
+    err = ctypes.c_char_p()
+    ptr = get_lib().ft_lighthouse_client_quorum(
+        lighthouse_addr.encode(),
+        json.dumps(requester).encode(),
+        _ms(timeout),
+        ctypes.byref(err),
+    )
+    check_error(err)
+    return json.loads(take_string(ptr))

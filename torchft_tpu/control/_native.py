@@ -1,0 +1,153 @@
+"""ctypes loader for the native control plane (libtorchft_tpu_native.so).
+
+Builds the library from native/ on first use if missing or stale (make is
+part of the baked toolchain). The C ABI is defined in native/capi.cc; the
+reference achieves the same Python↔native embedding with pyo3
+(/root/reference/src/lib.rs) — pybind11 is unavailable here, so the ABI is
+plain C consumed via ctypes, which also conveniently releases the GIL for
+every native call (parity with py.allow_threads at ref lib.rs:54,98).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libtorchft_tpu_native.so")
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for name in os.listdir(_NATIVE_DIR):
+        if name.endswith((".cc", ".h")):
+            if os.path.getmtime(os.path.join(_NATIVE_DIR, name)) > lib_mtime:
+                return True
+    return False
+
+
+def _build() -> None:
+    result = subprocess.run(
+        ["make", "-j", "-C", _NATIVE_DIR],
+        capture_output=True,
+        text=True,
+    )
+    if result.returncode != 0:
+        raise RuntimeError(
+            "failed to build native control plane:\n"
+            f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+        )
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    c_char_p = ctypes.c_char_p
+    c_void_p = ctypes.c_void_p
+    c_i64 = ctypes.c_int64
+    c_u64 = ctypes.c_uint64
+    c_int = ctypes.c_int
+    err_p = ctypes.POINTER(c_char_p)
+
+    lib.ft_free.argtypes = [c_void_p]
+    lib.ft_free.restype = None
+
+    lib.ft_lighthouse_new.argtypes = [
+        c_char_p, c_int, c_char_p, c_u64, c_u64, c_u64, c_u64, err_p,
+    ]
+    lib.ft_lighthouse_new.restype = c_void_p
+    lib.ft_lighthouse_address.argtypes = [c_void_p]
+    lib.ft_lighthouse_address.restype = c_void_p  # char* we must free
+    lib.ft_lighthouse_shutdown.argtypes = [c_void_p]
+    lib.ft_lighthouse_shutdown.restype = None
+    lib.ft_lighthouse_free.argtypes = [c_void_p]
+    lib.ft_lighthouse_free.restype = None
+
+    lib.ft_manager_new.argtypes = [
+        c_char_p, c_char_p, c_char_p, c_char_p, c_int, c_char_p,
+        c_u64, c_u64, c_u64, c_int, err_p,
+    ]
+    lib.ft_manager_new.restype = c_void_p
+    lib.ft_manager_address.argtypes = [c_void_p]
+    lib.ft_manager_address.restype = c_void_p
+    lib.ft_manager_kill_requested.argtypes = [c_void_p]
+    lib.ft_manager_kill_requested.restype = c_int
+    lib.ft_manager_shutdown.argtypes = [c_void_p]
+    lib.ft_manager_shutdown.restype = None
+    lib.ft_manager_free.argtypes = [c_void_p]
+    lib.ft_manager_free.restype = None
+
+    lib.ft_manager_client_new.argtypes = [c_char_p, c_u64, err_p]
+    lib.ft_manager_client_new.restype = c_void_p
+    lib.ft_manager_client_quorum.argtypes = [
+        c_void_p, c_i64, c_i64, c_char_p, c_int, c_u64, err_p,
+    ]
+    lib.ft_manager_client_quorum.restype = c_void_p
+    lib.ft_manager_client_checkpoint_metadata.argtypes = [
+        c_void_p, c_i64, c_u64, err_p,
+    ]
+    lib.ft_manager_client_checkpoint_metadata.restype = c_void_p
+    lib.ft_manager_client_should_commit.argtypes = [
+        c_void_p, c_i64, c_i64, c_int, c_u64, err_p,
+    ]
+    lib.ft_manager_client_should_commit.restype = c_int
+    lib.ft_manager_client_kill.argtypes = [c_void_p, c_char_p, c_u64, err_p]
+    lib.ft_manager_client_kill.restype = c_int
+    lib.ft_manager_client_free.argtypes = [c_void_p]
+    lib.ft_manager_client_free.restype = None
+
+    lib.ft_lighthouse_client_heartbeat.argtypes = [
+        c_char_p, c_char_p, c_u64, err_p,
+    ]
+    lib.ft_lighthouse_client_heartbeat.restype = c_int
+    lib.ft_lighthouse_client_quorum.argtypes = [
+        c_char_p, c_char_p, c_u64, err_p,
+    ]
+    lib.ft_lighthouse_client_quorum.restype = c_void_p
+
+    lib.ft_quorum_compute.argtypes = [c_i64, c_char_p, c_char_p, err_p]
+    lib.ft_quorum_compute.restype = c_void_p
+    lib.ft_compute_quorum_results.argtypes = [c_char_p, c_i64, c_char_p, err_p]
+    lib.ft_compute_quorum_results.restype = c_void_p
+    lib.ft_json_roundtrip.argtypes = [c_char_p, err_p]
+    lib.ft_json_roundtrip.restype = c_void_p
+
+
+def get_lib() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            if _needs_build():
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+            _configure(lib)
+            _lib = lib
+    return _lib
+
+
+def take_string(ptr: int) -> str:
+    """Copy a malloc'd char* into a Python str and free it."""
+    lib = get_lib()
+    try:
+        return ctypes.cast(ptr, ctypes.c_char_p).value.decode()  # type: ignore[union-attr]
+    finally:
+        lib.ft_free(ptr)
+
+
+def check_error(err: "ctypes.c_char_p") -> None:
+    """Raise from a `char** err` out-param; TIMEOUT: prefix → TimeoutError
+    (the Status→PyErr mapping of ref lib.rs:321-339)."""
+    if err.value is None:
+        return
+    msg = err.value.decode()
+    get_lib().ft_free(err)  # the C side malloc'd the message
+    if msg.startswith("TIMEOUT: "):
+        raise TimeoutError(msg[len("TIMEOUT: "):])
+    raise RuntimeError(msg)
