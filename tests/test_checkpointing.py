@@ -1,0 +1,96 @@
+"""Checkpoint transport tests (spec: ref checkpointing_test.py — roundtrip,
+wrong-step 400, gate blocking, shutdown)."""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torchft_tpu.checkpointing import CheckpointServer
+from torchft_tpu.utils.serialization import pytree_from_bytes, pytree_to_bytes
+
+
+def test_serialization_roundtrip() -> None:
+    import jax.numpy as jnp
+
+    tree = {
+        "params": {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)},
+        "step": 7,
+        "nested": [np.ones(2), "label", None],
+    }
+    out = pytree_from_bytes(pytree_to_bytes(tree))
+    np.testing.assert_allclose(out["params"]["w"], np.arange(6).reshape(2, 3))
+    assert isinstance(out["params"]["w"], np.ndarray)  # device -> host
+    assert out["step"] == 7
+    assert out["nested"][1] == "label"
+
+
+def test_checkpoint_roundtrip() -> None:
+    server = CheckpointServer(timeout=5.0)
+    state = {"user": {"w": np.full((4, 4), 3.5)}, "torchft": {"step": 3}}
+    server.send_checkpoint([1], step=3, state_dict=state, timeout=5.0)
+    got = server.recv_checkpoint(
+        src_rank=0, metadata=server.metadata(), step=3, timeout=5.0
+    )
+    np.testing.assert_allclose(got["user"]["w"], state["user"]["w"])
+    assert got["torchft"]["step"] == 3
+    server.shutdown()
+
+
+def test_wrong_step_is_400() -> None:
+    server = CheckpointServer(timeout=5.0)
+    server.send_checkpoint([1], step=3, state_dict={"x": 1}, timeout=5.0)
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        server.recv_checkpoint(
+            src_rank=0, metadata=server.metadata(), step=99, timeout=5.0
+        )
+    assert exc_info.value.code == 400
+    server.shutdown()
+
+
+def test_gate_blocks_until_staged() -> None:
+    # Fetch BEFORE the donor stages: must block then succeed, not 400
+    # (the donor/healer race described in checkpointing.py).
+    server = CheckpointServer(timeout=10.0)
+    results = {}
+
+    def _fetch():
+        results["state"] = server.recv_checkpoint(
+            src_rank=0, metadata=server.metadata(), step=5, timeout=10.0
+        )
+
+    t = threading.Thread(target=_fetch)
+    t.start()
+    time.sleep(0.2)
+    assert "state" not in results  # still gated
+    server.send_checkpoint([1], step=5, state_dict={"v": 42}, timeout=5.0)
+    t.join(timeout=10)
+    assert results["state"]["v"] == 42
+    server.shutdown()
+
+
+def test_disallow_closes_gate() -> None:
+    server = CheckpointServer(timeout=0.3)
+    server.send_checkpoint([1], step=1, state_dict={"x": 1}, timeout=5.0)
+    server.disallow_checkpoint()
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        server.recv_checkpoint(
+            src_rank=0, metadata=server.metadata(), step=1, timeout=5.0
+        )
+    assert exc_info.value.code == 503  # gate closed, wait times out
+    server.shutdown()
+
+
+def test_large_state_roundtrip() -> None:
+    server = CheckpointServer(timeout=30.0)
+    big = {"params": [np.random.default_rng(0).random(1 << 20) for _ in range(4)]}
+    server.send_checkpoint([1], step=1, state_dict=big, timeout=30.0)
+    got = server.recv_checkpoint(
+        src_rank=0, metadata=server.metadata(), step=1, timeout=30.0
+    )
+    for a, b in zip(big["params"], got["params"]):
+        np.testing.assert_array_equal(a, b)
+    server.shutdown()

@@ -1,0 +1,213 @@
+"""Tests for the CommContext layer (spec: ref process_group_test.py —
+the `_test_pg` collective sweep at :63-111, reconfigure behavior :216-250,
+error latching :379-403)."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.comm import (
+    DummyCommContext,
+    ErrorSwallowingCommContext,
+    ReduceOp,
+    StoreServer,
+    TcpCommContext,
+)
+
+
+@pytest.fixture()
+def store():
+    server = StoreServer()
+    yield server
+    server.shutdown()
+
+
+def _run_ranks(store, world_size, fn, prefix="q0", timeout=20.0):
+    """Run fn(ctx, rank) on `world_size` TcpCommContexts on threads."""
+    ctxs = [TcpCommContext(timeout=10.0) for _ in range(world_size)]
+    results = [None] * world_size
+
+    def _worker(rank):
+        ctx = ctxs[rank]
+        ctx.configure(f"{store.addr}/{prefix}", rank, world_size)
+        results[rank] = fn(ctx, rank)
+
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        futs = [pool.submit(_worker, r) for r in range(world_size)]
+        for f in futs:
+            f.result(timeout=timeout)
+    for ctx in ctxs:
+        ctx.shutdown()
+    return results
+
+
+@pytest.mark.parametrize("world_size", [1, 2, 4])
+def test_allreduce_sum(store, world_size) -> None:
+    def _fn(ctx, rank):
+        a = np.full((3, 4), float(rank + 1), dtype=np.float32)
+        b = np.arange(5, dtype=np.float64) * (rank + 1)
+        work = ctx.allreduce([a, b], op=ReduceOp.SUM)
+        return work.future().result(timeout=10)
+
+    results = _run_ranks(store, world_size, _fn)
+    expected_a = np.full((3, 4), sum(range(1, world_size + 1)), np.float32)
+    expected_b = np.arange(5, dtype=np.float64) * sum(range(1, world_size + 1))
+    for res in results:
+        np.testing.assert_allclose(res[0], expected_a)
+        np.testing.assert_allclose(res[1], expected_b)
+
+
+def test_allreduce_avg_and_max(store) -> None:
+    def _fn(ctx, rank):
+        avg = ctx.allreduce(
+            [np.full(4, float(rank), np.float32)], op=ReduceOp.AVG
+        ).future().result(timeout=10)
+        mx = ctx.allreduce(
+            [np.array([rank, -rank], np.int64)], op=ReduceOp.MAX
+        ).future().result(timeout=10)
+        return avg, mx
+
+    for avg, mx in _run_ranks(store, 3, _fn):
+        np.testing.assert_allclose(avg[0], np.full(4, 1.0, np.float32))
+        np.testing.assert_array_equal(mx[0], np.array([2, 0]))
+
+
+def test_broadcast(store) -> None:
+    def _fn(ctx, rank):
+        data = np.full(6, float(rank * 100 + 7), np.float32)
+        return ctx.broadcast([data], root=1).future().result(timeout=10)
+
+    for res in _run_ranks(store, 3, _fn):
+        np.testing.assert_allclose(res[0], np.full(6, 107.0, np.float32))
+
+
+def test_allgather(store) -> None:
+    def _fn(ctx, rank):
+        # different shapes per rank exercises the metadata path
+        data = np.arange(rank + 1, dtype=np.int32)
+        return ctx.allgather([data]).future().result(timeout=10)
+
+    for res in _run_ranks(store, 3, _fn):
+        assert len(res) == 3
+        for r in range(3):
+            np.testing.assert_array_equal(res[r][0], np.arange(r + 1))
+
+
+def test_multiple_sequential_ops(store) -> None:
+    def _fn(ctx, rank):
+        outs = []
+        for i in range(5):
+            w = ctx.allreduce([np.full(2, float(i + rank), np.float32)])
+            outs.append(w)
+        return [w.future().result(timeout=10)[0][0] for w in outs]
+
+    res = _run_ranks(store, 2, _fn)
+    assert res[0] == [2 * i + 1 for i in range(5)]
+    assert res[0] == res[1]
+
+
+def test_reconfigure_new_quorum(store) -> None:
+    # Same contexts reconfigured under a new prefix with fewer ranks
+    # (the per-quorum reconfiguration path, ref manager.py:470-477).
+    ctx0 = TcpCommContext(timeout=10.0)
+    ctx1 = TcpCommContext(timeout=10.0)
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        f0 = pool.submit(ctx0.configure, f"{store.addr}/q1", 0, 2)
+        f1 = pool.submit(ctx1.configure, f"{store.addr}/q1", 1, 2)
+        f0.result(timeout=10)
+        f1.result(timeout=10)
+        r = ctx0.allreduce([np.ones(2, np.float32)]).future()
+        r2 = ctx1.allreduce([np.ones(2, np.float32)]).future()
+        np.testing.assert_allclose(r.result(10)[0], np.full(2, 2.0))
+        r2.result(10)
+
+    # rank 1 dies; survivor reconfigures to world_size=1
+    ctx1.shutdown()
+    ctx0.configure(f"{store.addr}/q2", 0, 1)
+    out = ctx0.allreduce([np.ones(3, np.float32)]).future().result(timeout=10)
+    np.testing.assert_allclose(out[0], np.ones(3))
+    ctx0.shutdown()
+
+
+def test_peer_death_fails_op_and_latches(store) -> None:
+    ctx0 = TcpCommContext(timeout=5.0)
+    ctx1 = TcpCommContext(timeout=5.0)
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        f0 = pool.submit(ctx0.configure, f"{store.addr}/qx", 0, 2)
+        f1 = pool.submit(ctx1.configure, f"{store.addr}/qx", 1, 2)
+        f0.result(timeout=10)
+        f1.result(timeout=10)
+
+    ctx1.shutdown()  # peer vanishes
+    work = ctx0.allreduce([np.ones(4, np.float32)])
+    with pytest.raises((ConnectionError, OSError)):
+        work.future().result(timeout=10)
+    assert ctx0.errored() is not None
+    # subsequent ops fail fast
+    with pytest.raises((ConnectionError, OSError)):
+        ctx0.allreduce([np.ones(4)]).future().result(timeout=10)
+    # reconfigure clears the latch
+    ctx0.configure(f"{store.addr}/qy", 0, 1)
+    assert ctx0.errored() is None
+    ctx0.shutdown()
+
+
+def test_configure_timeout_when_peer_missing(store) -> None:
+    ctx = TcpCommContext(timeout=0.3)
+    with pytest.raises(TimeoutError):
+        ctx.configure(f"{store.addr}/lonely", 0, 2)
+    ctx.shutdown()
+
+
+def test_dummy_context() -> None:
+    ctx = DummyCommContext()
+    ctx.configure("ignored", 0, 1)
+    arrays = [np.arange(4, dtype=np.float32)]
+    out = ctx.allreduce(arrays).future().result(timeout=1)
+    np.testing.assert_array_equal(out[0], arrays[0])
+    assert ctx.size() == 1
+    assert ctx.configure_count == 1
+
+
+def test_error_swallowing_wrapper(store) -> None:
+    inner0 = TcpCommContext(timeout=5.0)
+    inner1 = TcpCommContext(timeout=5.0)
+    wrapped = ErrorSwallowingCommContext(inner0)
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        f0 = pool.submit(wrapped.configure, f"{store.addr}/es", 0, 2)
+        f1 = pool.submit(inner1.configure, f"{store.addr}/es", 1, 2)
+        f0.result(timeout=10)
+        f1.result(timeout=10)
+
+    # healthy op passes through
+    w = wrapped.allreduce([np.ones(2, np.float32)])
+    w2 = inner1.allreduce([np.ones(2, np.float32)])
+    np.testing.assert_allclose(w.future().result(10)[0], np.full(2, 2.0))
+    w2.future().result(10)
+    assert wrapped.errored() is None
+
+    # peer dies: wrapped op completes with identity instead of raising,
+    # and the error is latched (ref process_group.py:408-501)
+    inner1.shutdown()
+    arrays = [np.full(2, 5.0, np.float32)]
+    out = wrapped.allreduce(arrays).future().result(timeout=10)
+    np.testing.assert_array_equal(out[0], arrays[0])
+    assert wrapped.errored() is not None
+
+    # later ops short-circuit to identity until reconfigure
+    out = wrapped.allreduce([np.full(3, 2.0)]).future().result(timeout=1)
+    np.testing.assert_array_equal(out[0], np.full(3, 2.0))
+    wrapped.shutdown()
+
+
+def test_large_buffer_allreduce(store) -> None:
+    # ~32 MB per rank exercises chunked socket IO.
+    def _fn(ctx, rank):
+        data = np.full(8 << 20, float(rank + 1), dtype=np.float32)
+        return ctx.allreduce([data]).future().result(timeout=30)
+
+    results = _run_ranks(store, 2, _fn, timeout=60.0)
+    np.testing.assert_allclose(results[0][0][:10], np.full(10, 3.0))
+    np.testing.assert_allclose(results[1][0][-10:], np.full(10, 3.0))

@@ -1,0 +1,394 @@
+"""Manager state-machine tests with a mocked control plane.
+
+Spec: the reference's mock-based suite (ref manager_test.py) — fabricated
+QuorumResults drive the full state machine without any real lighthouse:
+happy path (:130-163), sync heal (:166-212), async heal participation
+(:215-276), zero-grad numerics while healing (:279-336), allreduce error
+injection (:339-405), spares mode (:408-442), allow_heal=False (:445-476),
+wrap_future timeout (:505-518), gradient scaling (:521-543).
+"""
+
+import threading
+from concurrent.futures import Future
+from typing import List, Optional
+from unittest.mock import MagicMock, patch
+
+import numpy as np
+import pytest
+
+from torchft_tpu.checkpointing import CheckpointServer
+from torchft_tpu.comm.context import (
+    CommContext,
+    CompletedWork,
+    FailedWork,
+    ReduceOp,
+    Work,
+)
+from torchft_tpu.comm.store import StoreServer
+from torchft_tpu.control import QuorumResult
+from torchft_tpu.manager import Manager, WorldSizeMode
+
+
+class FakeComm(CommContext):
+    """Single-replica stand-in: allreduce is identity-sum; failures
+    injectable per-op (the create_autospec(ProcessGroup) analog)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.configure_calls: List[tuple] = []
+        self.fail_next: Optional[Exception] = None
+        self.hang_next = False
+
+    def configure(self, store_addr, rank, world_size):
+        self.configure_calls.append((store_addr, rank, world_size))
+        self._rank, self._world_size = rank, world_size
+
+    def allreduce(self, arrays, op=ReduceOp.SUM):
+        if self.fail_next is not None:
+            exc, self.fail_next = self.fail_next, None
+            return FailedWork(exc)
+        if self.hang_next:
+            self.hang_next = False
+            return Work(Future())  # never completes
+        return CompletedWork([np.array(a, copy=True) for a in arrays])
+
+    def allgather(self, arrays):
+        return CompletedWork([list(arrays)])
+
+    def broadcast(self, arrays, root=0):
+        return CompletedWork(list(arrays))
+
+
+def quorum_result(
+    quorum_id=1,
+    replica_rank=0,
+    replica_world_size=2,
+    recover_src_rank=None,
+    recover_src_manager_address="",
+    recover_dst_ranks=(),
+    store_address="store",
+    max_step=0,
+    max_rank=0,
+    max_world_size=2,
+    heal=False,
+):
+    return QuorumResult(
+        quorum_id=quorum_id,
+        replica_rank=replica_rank,
+        replica_world_size=replica_world_size,
+        recover_src_manager_address=recover_src_manager_address,
+        recover_src_rank=recover_src_rank,
+        recover_dst_ranks=list(recover_dst_ranks),
+        store_address=store_address,
+        max_step=max_step,
+        max_rank=max_rank,
+        max_world_size=max_world_size,
+        heal=heal,
+    )
+
+
+@pytest.fixture()
+def store():
+    server = StoreServer()
+    yield server
+    server.shutdown()
+
+
+def make_manager(store, comm=None, state=None, **kwargs):
+    """Build a Manager with mocked native control plane pieces."""
+    comm = comm or FakeComm()
+    state = state if state is not None else {"w": np.zeros(2)}
+
+    def load_state_dict(sd):
+        state.clear()
+        state.update(sd)
+
+    defaults = dict(
+        min_replica_size=2,
+        use_async_quorum=True,
+        rank=0,
+        world_size=1,
+        store_addr=store.addr,
+        lighthouse_addr="http://mock-lighthouse:1",
+        timeout=5.0,
+        quorum_timeout=5.0,
+        connect_timeout=5.0,
+    )
+    defaults.update(kwargs)
+    with patch("torchft_tpu.manager.ManagerServer") as mock_server, patch(
+        "torchft_tpu.manager.ManagerClient"
+    ) as mock_client_cls:
+        mock_server.return_value.address.return_value = "http://mock:1"
+        client = MagicMock()
+        mock_client_cls.return_value = client
+        manager = Manager(
+            comm=comm,
+            load_state_dict=load_state_dict,
+            state_dict=lambda: dict(state),
+            **defaults,
+        )
+    return manager, client, comm, state
+
+
+def test_happy_path_step_commit(store) -> None:
+    manager, client, comm, _ = make_manager(store)
+    client.quorum.return_value = quorum_result()
+    client.should_commit.return_value = True
+
+    assert manager.current_step() == 0
+    manager.start_quorum()
+    fut = manager.allreduce_arrays([np.full(3, 4.0, np.float32)]).future()
+    out = fut.result(timeout=5)
+    # identity-sum comm, 2 participants -> /2
+    np.testing.assert_allclose(out[0], np.full(3, 2.0))
+    assert manager.should_commit()
+    assert manager.current_step() == 1
+    assert manager.batches_committed() == 2
+    assert len(comm.configure_calls) == 1
+    assert comm.configure_calls[0] == ("store/torchft/1/0", 0, 2)
+    manager.shutdown(wait=False)
+
+
+def test_quorum_id_change_reconfigures(store) -> None:
+    manager, client, comm, _ = make_manager(store)
+    client.should_commit.return_value = True
+    client.quorum.return_value = quorum_result(quorum_id=1)
+    manager.start_quorum()
+    manager.wait_quorum()
+    client.quorum.return_value = quorum_result(quorum_id=1)
+    manager.start_quorum()
+    manager.wait_quorum()
+    assert len(comm.configure_calls) == 1  # same id -> no reconfigure
+    client.quorum.return_value = quorum_result(quorum_id=2)
+    manager.start_quorum()
+    manager.wait_quorum()
+    assert len(comm.configure_calls) == 2
+    manager.shutdown(wait=False)
+
+
+def test_async_heal_not_participating_zero_grads(store) -> None:
+    # Healing replica: participates=False, contributes zeros, but
+    # should_commit still votes True (healing != error); step is fast-
+    # forwarded from the donor checkpoint (ref manager_test.py:215-336).
+    donor_server = CheckpointServer(timeout=5.0)
+    donor_server.allow_checkpoint(
+        20,
+        {"user": {"w": np.full(2, 7.0)}, "torchft": {"step": 20, "batches_committed": 40}},
+    )
+
+    manager, client, comm, state = make_manager(store)
+    client.quorum.return_value = quorum_result(
+        quorum_id=3,
+        replica_rank=1,
+        replica_world_size=2,
+        recover_src_rank=0,
+        recover_src_manager_address="http://donor:1",
+        max_step=20,
+        max_rank=None,
+        max_world_size=1,
+        heal=True,
+    )
+    client.should_commit.return_value = True
+
+    with patch("torchft_tpu.manager.ManagerClient") as heal_client_cls:
+        heal_client_cls.return_value.checkpoint_metadata.return_value = (
+            donor_server.address()
+        )
+        manager.start_quorum()
+        fut = manager.allreduce_arrays([np.full(2, 9.0, np.float32)]).future()
+        out = fut.result(timeout=5)
+    # not participating -> zeros in, zeros out (scaled)
+    np.testing.assert_allclose(out[0], np.zeros(2))
+    assert not manager.is_participating()
+    assert manager.num_participants() == 1
+
+    assert manager.should_commit()
+    # user state applied during should_commit (async mode)
+    np.testing.assert_allclose(state["w"], np.full(2, 7.0))
+    assert manager.current_step() == 21  # 20 from donor +1 on commit
+    donor_server.shutdown()
+    manager.shutdown(wait=False)
+
+
+def test_sync_quorum_heals_eagerly(store) -> None:
+    donor_server = CheckpointServer(timeout=5.0)
+    donor_server.allow_checkpoint(
+        5,
+        {"user": {"w": np.full(2, 3.0)}, "torchft": {"step": 5, "batches_committed": 10}},
+    )
+    manager, client, comm, state = make_manager(
+        store, use_async_quorum=False
+    )
+    client.quorum.return_value = quorum_result(
+        quorum_id=1,
+        replica_rank=1,
+        replica_world_size=2,
+        recover_src_rank=0,
+        recover_src_manager_address="http://donor:1",
+        max_step=5,
+        max_rank=None,
+        max_world_size=1,
+        heal=True,
+    )
+    client.should_commit.return_value = True
+    with patch("torchft_tpu.manager.ManagerClient") as heal_client_cls:
+        heal_client_cls.return_value.checkpoint_metadata.return_value = (
+            donor_server.address()
+        )
+        manager.start_quorum()
+    # sync mode: healed eagerly, full participation (replica_rank/world)
+    np.testing.assert_allclose(state["w"], np.full(2, 3.0))
+    assert manager.is_participating()
+    assert manager.num_participants() == 2
+    assert manager.current_step() == 5
+    donor_server.shutdown()
+    manager.shutdown(wait=False)
+
+
+def test_allreduce_error_latches_and_skips(store) -> None:
+    manager, client, comm, _ = make_manager(store)
+    client.quorum.return_value = quorum_result()
+    client.should_commit.return_value = False
+    manager.start_quorum()
+
+    comm.fail_next = RuntimeError("injected comm failure")
+    arrays = [np.full(2, 6.0, np.float32)]
+    out = manager.allreduce_arrays(arrays).future().result(timeout=5)
+    # error swallowed -> default (input) returned
+    np.testing.assert_allclose(out[0], np.full(2, 6.0))
+    assert manager.errored() is not None
+
+    # subsequent allreduces no-op immediately
+    out2 = manager.allreduce_arrays([np.ones(2)]).future().result(timeout=5)
+    np.testing.assert_allclose(out2[0], np.ones(2))
+
+    # local vote must be False
+    assert manager.should_commit() is False
+    args = client.should_commit.call_args
+    assert args.args[2] is False  # local_should_commit
+    assert manager.current_step() == 0  # not incremented
+
+    # next quorum clears the error
+    client.quorum.return_value = quorum_result(quorum_id=2)
+    manager.start_quorum()
+    manager.wait_quorum()
+    assert manager.errored() is None
+    manager.shutdown(wait=False)
+
+
+def test_wrap_future_timeout_latches(store) -> None:
+    manager, client, comm, _ = make_manager(store, timeout=0.5)
+    client.quorum.return_value = quorum_result()
+    manager.start_quorum()
+    comm.hang_next = True
+    out = manager.allreduce_arrays(
+        [np.full(2, 1.5, np.float32)]
+    ).future()
+    result = out.result(timeout=10)
+    np.testing.assert_allclose(result[0], np.full(2, 1.5))
+    assert isinstance(manager.errored(), TimeoutError)
+    manager.shutdown(wait=False)
+
+
+def test_spares_mode_clamps_participation(store) -> None:
+    # FIXED_WITH_SPARES: world clamped to min_replica_size; ranks beyond it
+    # are parked (ref manager_test.py:408-442).
+    manager, client, comm, _ = make_manager(
+        store, world_size_mode=WorldSizeMode.FIXED_WITH_SPARES,
+        min_replica_size=2,
+    )
+    client.quorum.return_value = quorum_result(
+        replica_rank=2, replica_world_size=3, max_rank=2, max_world_size=3
+    )
+    manager.start_quorum()
+    manager.wait_quorum()
+    assert manager.num_participants() == 2
+    assert not manager.is_participating()  # parked spare
+
+    client.quorum.return_value = quorum_result(
+        quorum_id=2, replica_rank=1, replica_world_size=3, max_rank=1,
+        max_world_size=3,
+    )
+    manager.start_quorum()
+    manager.wait_quorum()
+    assert manager.is_participating()
+    assert manager.num_participants() == 2
+    manager.shutdown(wait=False)
+
+
+def test_allow_heal_false_uses_full_quorum(store) -> None:
+    # allow_heal=False: no checkpoint traffic even when quorum says heal
+    # (ref manager_test.py:445-476).
+    manager, client, comm, _ = make_manager(store)
+    client.quorum.return_value = quorum_result(
+        replica_rank=1,
+        replica_world_size=2,
+        recover_src_rank=0,
+        recover_dst_ranks=[],
+        max_step=3,
+        max_rank=None,
+        max_world_size=1,
+        heal=True,
+    )
+    manager.start_quorum(allow_heal=False)
+    manager.wait_quorum()
+    assert not manager._healing
+    # with allow_heal False participation comes from the max cohort
+    assert manager.num_participants() == 1
+    manager.shutdown(wait=False)
+
+
+def test_min_replicas_vote_false(store) -> None:
+    manager, client, comm, _ = make_manager(store, min_replica_size=2)
+    client.quorum.return_value = quorum_result(
+        replica_world_size=1, max_world_size=1, max_rank=0
+    )
+    client.should_commit.return_value = False
+    manager.start_quorum()
+    manager.wait_quorum()
+    assert manager.should_commit() is False
+    assert client.should_commit.call_args.args[2] is False
+    manager.shutdown(wait=False)
+
+
+def test_donor_serves_recovering_peers(store) -> None:
+    # recover_dst_ranks non-empty -> checkpoint staged for that step
+    # (ref manager.py:479-489).
+    manager, client, comm, state = make_manager(store)
+    client.quorum.return_value = quorum_result(
+        max_step=7, recover_dst_ranks=[1]
+    )
+    manager.start_quorum()
+    manager.wait_quorum()
+    transport = manager._checkpoint_transport
+    assert transport._staged_step == 7
+    staged = transport._staged_state
+    assert staged["torchft"]["step"] == 0
+    assert "w" in staged["user"]
+    manager.shutdown(wait=False)
+
+
+def test_state_dict_roundtrip(store) -> None:
+    manager, client, comm, _ = make_manager(store)
+    client.quorum.return_value = quorum_result()
+    client.should_commit.return_value = True
+    manager.start_quorum()
+    assert manager.should_commit()
+    sd = manager.state_dict()
+    assert sd == {"step": 1, "batches_committed": 2}
+
+    manager2, _, _, _ = make_manager(store)
+    manager2.load_state_dict(sd)
+    assert manager2.current_step() == 1
+    assert manager2.batches_committed() == 2
+    manager.shutdown(wait=False)
+    manager2.shutdown(wait=False)
+
+
+def test_quorum_timeout_plumbing(store) -> None:
+    manager, client, comm, _ = make_manager(store)
+    client.quorum.return_value = quorum_result()
+    manager.start_quorum(timeout=12.5)
+    manager.wait_quorum()
+    assert client.quorum.call_args.kwargs["timeout"] == 12.5
+    manager.shutdown(wait=False)

@@ -1,0 +1,205 @@
+"""Live checkpoint transport for healing replicas.
+
+TPU-native rendering of the reference's checkpoint plane
+(/root/reference/torchft/checkpointing.py:34-270): an up-to-date replica
+serves its in-memory state dict over HTTP; a healing replica fetches it at
+the step boundary. Serving is lock-gated so the training loop can never
+mutate state mid-send — `send_checkpoint` stages the state and opens the
+gate for a specific step; `should_commit` closes it again
+(ref manager.py:591).
+
+The payload is a streamed pytree pickle (device→host via
+utils/serialization); on TPU the device_get happens once at staging time,
+and a donor can serve many healing peers from the same staged host copy.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import urllib.request
+from abc import ABC, abstractmethod
+from datetime import timedelta
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Generic, List, Optional, TypeVar
+
+from torchft_tpu.utils.serialization import pytree_from_stream, pytree_to_stream, to_host
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+__all__ = ["CheckpointTransport", "CheckpointServer"]
+
+
+class CheckpointTransport(ABC, Generic[T]):
+    """Pluggable transport moving live checkpoints donor→healer
+    (ref checkpointing.py:34-88)."""
+
+    @abstractmethod
+    def metadata(self) -> str:
+        """Metadata string advertised via the manager's CheckpointMetadata
+        RPC (e.g. the donor's serving URL)."""
+
+    @abstractmethod
+    def send_checkpoint(
+        self, dst_ranks: List[int], step: int, state_dict: T,
+        timeout: "float | timedelta",
+    ) -> None:
+        """Stage `state_dict` for the given recovering ranks at `step`."""
+
+    def disallow_checkpoint(self) -> None:  # noqa: B027 — optional hook
+        """Close the serving gate (training may mutate state again)."""
+
+    @abstractmethod
+    def recv_checkpoint(
+        self, src_rank: int, metadata: str, step: int,
+        timeout: "float | timedelta",
+    ) -> T:
+        """Fetch the checkpoint staged by the donor for `step`."""
+
+    def shutdown(self, wait: bool = True) -> None:  # noqa: B027
+        """Tear down any serving resources."""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "torchft_tpu_ckpt"
+
+    def log_message(self, format: str, *args) -> None:  # noqa: A002
+        logger.debug("checkpoint http: " + format, *args)
+
+    def do_GET(self) -> None:  # noqa: N802
+        server: "CheckpointServer" = self.server.ckpt_server  # type: ignore[attr-defined]
+        prefix = "/checkpoint/"
+        if not self.path.startswith(prefix):
+            self.send_error(404, "unknown path")
+            return
+        try:
+            step = int(self.path[len(prefix):])
+        except ValueError:
+            self.send_error(400, "bad step")
+            return
+        # Gate: block until the donor has staged a checkpoint. A healer's
+        # fetch can land before the donor's send_checkpoint staged the state
+        # (both sides act on the same quorum response concurrently), so the
+        # gate must WAIT, not fail (ref checkpointing.py:139-170 holds a
+        # lock while disallowed for the same reason).
+        with server._cond:
+            opened = server._cond.wait_for(
+                lambda: not server._disallowed, timeout=server._timeout
+            )
+            if not opened:
+                self.send_error(
+                    503,
+                    f"timed out waiting for checkpoint gate for step {step}",
+                )
+                return
+            if server._staged_step != step:
+                self.send_error(
+                    400,
+                    f"checkpoint for step {step} not available "
+                    f"(staged={server._staged_step})",
+                )
+                return
+            # Pin a local ref: the staged object is a dedicated host copy
+            # (never mutated by training), so streaming can proceed outside
+            # the gate and disallow_checkpoint stays non-blocking.
+            staged = server._staged_state
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            # Chunked-free streaming: close delimits the body.
+            self.send_header("Connection", "close")
+            self.end_headers()
+            # staged is already an all-host copy (send_checkpoint converted)
+            pytree_to_stream(staged, self.wfile, convert=False)
+        except (BrokenPipeError, ConnectionResetError):
+            logger.warning("checkpoint receiver disconnected mid-stream")
+        self.close_connection = True
+
+
+class CheckpointServer(CheckpointTransport[T]):
+    """Daemon-thread HTTP server streaming the staged state dict
+    (ref checkpointing.py:110-270)."""
+
+    def __init__(self, timeout: "float | timedelta" = 60.0,
+                 num_chunks: int = 0) -> None:
+        if isinstance(timeout, timedelta):
+            timeout = timeout.total_seconds()
+        self._timeout = float(timeout)
+        self._cond = threading.Condition()
+        self._disallowed = True
+        self._staged_step = -1
+        self._staged_state: Optional[object] = None
+        del num_chunks  # reserved: parallel chunked transfer
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", 0), _Handler)
+        self._server.daemon_threads = True
+        self._server.request_queue_size = 1024  # ref http.py:1-7
+        self._server.ckpt_server = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="torchft_tpu_ckpt_server",
+            daemon=True,
+        )
+        self._thread.start()
+
+        host = socket.gethostname()
+        try:
+            socket.getaddrinfo(host, None)
+        except OSError:
+            host = "127.0.0.1"
+        self._addr = f"http://{host}:{self._server.server_address[1]}"
+
+    # -- CheckpointTransport ------------------------------------------------
+
+    def metadata(self) -> str:
+        return self._addr
+
+    def send_checkpoint(
+        self, dst_ranks: List[int], step: int, state_dict: T,
+        timeout: "float | timedelta",
+    ) -> None:
+        # Stage a host copy NOW (device_get) so later training-step mutations
+        # of device state can't tear the served bytes, then open the gate.
+        del dst_ranks  # HTTP transport serves whoever fetches
+        staged = to_host(state_dict)
+        with self._cond:
+            self._staged_state = staged
+            self._staged_step = step
+            self._disallowed = False
+            self._cond.notify_all()
+
+    def disallow_checkpoint(self) -> None:
+        with self._cond:
+            if not self._disallowed:
+                self._disallowed = True
+                self._staged_state = None
+
+    def recv_checkpoint(
+        self, src_rank: int, metadata: str, step: int,
+        timeout: "float | timedelta",
+    ) -> T:
+        del src_rank
+        if isinstance(timeout, timedelta):
+            timeout = timeout.total_seconds()
+        url = f"{metadata}/checkpoint/{step}"
+        logger.info("fetching checkpoint from %s", url)
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return pytree_from_stream(resp)
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if wait:
+            self._thread.join(timeout=5.0)
+
+    # -- convenience for tests (ref manager_test.py:184-193 pre-seeding) ----
+
+    def allow_checkpoint(self, step: int, state_dict: T) -> None:
+        self.send_checkpoint([], step, state_dict, self._timeout)
+
+    def address(self) -> str:
+        return self._addr

@@ -4,3 +4,14 @@ from torchft_tpu.comm.store import (  # noqa: F401
     StoreServer,
     create_store_client,
 )
+from torchft_tpu.comm.context import (  # noqa: F401
+    CommContext,
+    CompletedWork,
+    DummyCommContext,
+    ErrorSwallowingCommContext,
+    FailedWork,
+    ManagedCommContext,
+    ReduceOp,
+    Work,
+)
+from torchft_tpu.comm.transport import TcpCommContext  # noqa: F401

@@ -1,0 +1,264 @@
+"""Reconfigurable cross-replica communication contexts.
+
+TPU-native analog of the reference's reconfigurable ProcessGroups
+(/root/reference/torchft/process_group.py:123-569). On TPU the two comm
+planes split cleanly:
+
+- **In-group (intra-slice)**: jax.lax collectives over the ICI mesh inside
+  pjit/shard_map — compiled into the step function, never reconfigured
+  (an ICI failure kills the whole slice; see torchft_tpu/parallel/).
+- **Cross-replica (DCN)**: gradient averaging across replica groups, where
+  membership changes per-step with the quorum. THAT plane is what a
+  CommContext abstracts: host-side collectives over sockets that can be
+  torn down and rebuilt at step boundaries (`configure`), with
+  error-latching futures instead of job-killing exceptions.
+
+Buffers are numpy arrays (host memory). The Manager moves jax arrays
+device→host before reduction and host→device after; XLA's async dispatch
+overlaps that with compute.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from abc import ABC, abstractmethod
+from concurrent.futures import Future
+from datetime import timedelta
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from torchft_tpu.futures import completed_future, failed_future
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "Work",
+    "CompletedWork",
+    "FailedWork",
+    "CommContext",
+    "DummyCommContext",
+    "ErrorSwallowingCommContext",
+    "ManagedCommContext",
+    "ReduceOp",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    AVG = "avg"
+    MAX = "max"
+    MIN = "min"
+
+
+class Work:
+    """Handle for an in-flight collective (the c10d Work analog,
+    ref process_group.py:150-187). ``future()`` resolves to the op's result
+    (list of np.ndarray) or raises the transport error."""
+
+    def __init__(self, fut: "Future[List[np.ndarray]]") -> None:
+        self._fut = fut
+
+    def wait(self, timeout: "float | timedelta | None" = None) -> bool:
+        if isinstance(timeout, timedelta):
+            timeout = timeout.total_seconds()
+        self._fut.result(timeout=timeout)
+        return True
+
+    def future(self) -> "Future[List[np.ndarray]]":
+        return self._fut
+
+
+class CompletedWork(Work):
+    """Immediately-successful work (the _DummyWork analog,
+    ref process_group.py:339-351)."""
+
+    def __init__(self, result: Optional[List[np.ndarray]] = None) -> None:
+        super().__init__(completed_future(result if result is not None else []))
+
+
+class FailedWork(Work):
+    def __init__(self, exc: Exception) -> None:
+        super().__init__(failed_future(exc))
+
+
+class CommContext(ABC):
+    """Abstract reconfigurable cross-replica collective context
+    (ref process_group.py:123-247 `ProcessGroup`).
+
+    ``configure(store_addr, rank, world_size)`` tears down any previous
+    transport state and (re)builds for the new membership. The store address
+    carries a per-quorum prefix (``host:port/torchft/{quorum_id}``) so
+    stale rounds cannot cross-talk (ref manager.py:470-477).
+    """
+
+    def __init__(self) -> None:
+        self._rank = 0
+        self._world_size = 1
+
+    @abstractmethod
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        ...
+
+    @abstractmethod
+    def allreduce(
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
+    ) -> Work:
+        """Reduce arrays across ranks. The returned work's future resolves
+        to the reduced arrays (same shapes/dtypes, index-aligned)."""
+
+    @abstractmethod
+    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
+        """Future resolves to a list of per-rank lists of arrays."""
+
+    @abstractmethod
+    def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
+        """Future resolves to root's arrays on every rank."""
+
+    def size(self) -> int:
+        return self._world_size
+
+    def rank(self) -> int:
+        return self._rank
+
+    def shutdown(self) -> None:  # noqa: B027 — optional hook
+        pass
+
+    def errored(self) -> Optional[Exception]:
+        """Latched transport error, if any (cleared by configure)."""
+        return None
+
+
+class DummyCommContext(CommContext):
+    """World-size-1 context that completes every op with its own inputs —
+    used to soak bring-up collectives and as the cross-replica context when
+    only one replica group participates (ref process_group.py:354-405)."""
+
+    def __init__(self, rank: int = 0, world_size: int = 1) -> None:
+        super().__init__()
+        self._rank = rank
+        self._world_size = world_size
+        self.configure_count = 0
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self._rank = rank
+        self._world_size = world_size
+        self.configure_count += 1
+
+    def allreduce(
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
+    ) -> Work:
+        return CompletedWork(list(arrays))
+
+    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
+        return CompletedWork([list(arrays)])
+
+    def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
+        return CompletedWork(list(arrays))
+
+
+class ErrorSwallowingCommContext(CommContext):
+    """Wrapper that latches the first transport error and turns subsequent
+    ops into no-ops until the next configure — so one failed collective
+    poisons the *step*, not the *process*
+    (ref process_group.py:408-501 ErrorSwallowingProcessGroupWrapper)."""
+
+    def __init__(self, inner: CommContext) -> None:
+        super().__init__()
+        self._inner = inner
+        self._error: Optional[Exception] = None
+        self._lock = threading.Lock()
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        with self._lock:
+            self._error = None
+        self._inner.configure(store_addr, rank, world_size)
+
+    def errored(self) -> Optional[Exception]:
+        with self._lock:
+            return self._error
+
+    def report_error(self, exc: Exception) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = exc
+                logger.warning("comm context error latched: %s", exc)
+
+    def _wrap(self, work: Work, fallback: List[np.ndarray]) -> Work:
+        out: "Future[List[np.ndarray]]" = Future()
+        out.set_running_or_notify_cancel()
+
+        def _done(f: Future) -> None:
+            exc = f.exception()
+            if exc is not None:
+                self.report_error(exc)  # type: ignore[arg-type]
+                out.set_result(fallback)  # swallowed: op becomes identity
+            else:
+                out.set_result(f.result())
+
+        work.future().add_done_callback(_done)
+        return Work(out)
+
+    def allreduce(
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
+    ) -> Work:
+        if self.errored() is not None:
+            return CompletedWork(list(arrays))
+        return self._wrap(self._inner.allreduce(arrays, op), list(arrays))
+
+    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
+        if self.errored() is not None:
+            return CompletedWork([list(arrays)])
+        return self._wrap(self._inner.allgather(arrays), [list(arrays)])
+
+    def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
+        if self.errored() is not None:
+            return CompletedWork(list(arrays))
+        return self._wrap(self._inner.broadcast(arrays, root), list(arrays))
+
+    def size(self) -> int:
+        return self._inner.size()
+
+    def rank(self) -> int:
+        return self._inner.rank()
+
+    def shutdown(self) -> None:
+        self._inner.shutdown()
+
+
+class ManagedCommContext(CommContext):
+    """Context that routes every collective through a Manager so errors and
+    quorum state are handled centrally (ref process_group.py:504-569
+    ManagedProcessGroup). size() reports the number of participating
+    replicas in the current quorum."""
+
+    def __init__(self, manager) -> None:  # torchft_tpu.manager.Manager
+        super().__init__()
+        self._manager = manager
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        raise RuntimeError(
+            "ManagedCommContext is configured by its Manager, not directly"
+        )
+
+    def allreduce(
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
+    ) -> Work:
+        return self._manager.allreduce_arrays(arrays, op=op)
+
+    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
+        raise NotImplementedError(
+            "managed allgather is not part of the manager surface"
+        )
+
+    def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
+        raise NotImplementedError(
+            "managed broadcast is not part of the manager surface"
+        )
+
+    def size(self) -> int:
+        return self._manager.num_participants()
+
+    def rank(self) -> int:
+        return self._manager.participating_rank() or 0

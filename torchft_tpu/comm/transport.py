@@ -1,0 +1,365 @@
+"""TCP transport for cross-replica collectives (DCN plane).
+
+The reference's data plane is c10d Gloo/NCCL rebuilt per quorum
+(ref process_group.py:250-336). On TPU, cross-replica-group traffic rides
+the data-center network between hosts, so the equivalent is a host-side
+socket transport that is rebuilt per quorum from the rendezvous store:
+
+    configure(store_addr, rank, world_size):
+        rank 0 binds an ephemeral listener and publishes it in the store;
+        other ranks connect. Star topology: rank 0 reduces and fans out.
+
+Every collective is queued onto one transport thread per context and
+processed strictly in issue order (the usual collective contract: all ranks
+issue identical op sequences). Reconfigure/shutdown closes sockets, which
+fails in-flight ops with ConnectionError — the abort analog for wedged
+transports (XLA collectives cannot be aborted; host sockets can,
+SURVEY.md §7 hard-part #2).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import socket
+import struct
+import threading
+from concurrent.futures import Future
+from datetime import timedelta
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from torchft_tpu.comm.context import CommContext, ReduceOp, Work
+from torchft_tpu.comm.store import create_store_client
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["TcpCommContext"]
+
+_OP_ALLREDUCE = 1
+_OP_ALLGATHER = 2
+_OP_BROADCAST = 3
+
+_REDUCE_FNS = {
+    ReduceOp.SUM: lambda a, b: np.add(a, b, out=a),
+    ReduceOp.MAX: lambda a, b: np.maximum(a, b, out=a),
+    ReduceOp.MIN: lambda a, b: np.minimum(a, b, out=a),
+}
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise ConnectionError("comm transport connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _send_arrays(sock: socket.socket, arrays: Sequence[np.ndarray]) -> None:
+    # Per-array [dtype][ndim][shape][nbytes] header immediately followed by
+    # its payload, matching _recv_arrays' read order.
+    sock.sendall(struct.pack("<I", len(arrays)))
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        dt = a.dtype.str.encode()
+        header = b"".join(
+            (
+                struct.pack("<H", len(dt)),
+                dt,
+                struct.pack("<B", a.ndim),
+                struct.pack(f"<{a.ndim}q", *a.shape) if a.ndim else b"",
+                struct.pack("<Q", a.nbytes),
+            )
+        )
+        sock.sendall(header + a.tobytes())
+
+
+def _recv_arrays(sock: socket.socket) -> List[np.ndarray]:
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    out: List[np.ndarray] = []
+    for _ in range(n):
+        (dlen,) = struct.unpack("<H", _recv_exact(sock, 2))
+        dtype = np.dtype(_recv_exact(sock, dlen).decode())
+        (ndim,) = struct.unpack("<B", _recv_exact(sock, 1))
+        shape = struct.unpack(f"<{ndim}q", _recv_exact(sock, 8 * ndim)) if ndim else ()
+        (nbytes,) = struct.unpack("<Q", _recv_exact(sock, 8))
+        data = _recv_exact(sock, nbytes)
+        out.append(np.frombuffer(data, dtype=dtype).reshape(shape).copy())
+    return out
+
+
+class _PendingOp:
+    def __init__(self, opcode: int, arrays: List[np.ndarray], op: str,
+                 root: int, fut: Future) -> None:
+        self.opcode = opcode
+        self.arrays = arrays
+        self.op = op
+        self.root = root
+        self.fut = fut
+
+
+class TcpCommContext(CommContext):
+    """Reconfigurable star-topology collective context over TCP."""
+
+    def __init__(self, timeout: "float | timedelta" = 60.0) -> None:
+        super().__init__()
+        if isinstance(timeout, timedelta):
+            timeout = timeout.total_seconds()
+        self._timeout = float(timeout)
+        self._generation = 0
+        self._lock = threading.Lock()
+        self._queue: "queue.Queue[Optional[_PendingOp]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._listener: Optional[socket.socket] = None
+        self._peer_socks: Dict[int, socket.socket] = {}   # root only
+        self._root_sock: Optional[socket.socket] = None   # non-root only
+        self._error: Optional[Exception] = None
+        self._seq = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self.shutdown()
+        with self._lock:
+            self._generation += 1
+            self._rank = rank
+            self._world_size = world_size
+            self._error = None
+            self._seq = 0
+            self._queue = queue.Queue()
+
+        if world_size == 1:
+            # Solo quorum: everything is an identity op, no sockets needed.
+            self._thread = threading.Thread(
+                target=self._run_loop, name="torchft_tpu_comm", daemon=True
+            )
+            self._thread.start()
+            return
+
+        store = create_store_client(store_addr, timeout=self._timeout)
+        if rank == 0:
+            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            listener.bind(("0.0.0.0", 0))
+            listener.listen(world_size)
+            listener.settimeout(self._timeout)
+            self._listener = listener
+            host = socket.gethostname()
+            try:
+                socket.getaddrinfo(host, None)
+            except OSError:
+                host = "127.0.0.1"
+            store.set("comm_addr", f"{host}:{listener.getsockname()[1]}")
+            peers: Dict[int, socket.socket] = {}
+            try:
+                while len(peers) < world_size - 1:
+                    conn, _ = listener.accept()
+                    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    conn.settimeout(self._timeout)
+                    (peer_rank,) = struct.unpack("<I", _recv_exact(conn, 4))
+                    peers[peer_rank] = conn
+            except (OSError, socket.timeout) as e:
+                for s in peers.values():
+                    s.close()
+                listener.close()
+                raise TimeoutError(
+                    f"comm configure: rank 0 timed out waiting for "
+                    f"{world_size - 1} peers ({len(peers)} joined): {e}"
+                ) from e
+            self._peer_socks = peers
+        else:
+            addr = store.wait("comm_addr", timeout=self._timeout).decode()
+            host, port_s = addr.rsplit(":", 1)
+            sock = socket.create_connection(
+                (host, int(port_s)), timeout=self._timeout
+            )
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(self._timeout)
+            sock.sendall(struct.pack("<I", rank))
+            self._root_sock = sock
+
+        self._thread = threading.Thread(
+            target=self._run_loop, name="torchft_tpu_comm", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            thread = self._thread
+            self._thread = None
+            if thread is not None:
+                self._queue.put(None)  # sentinel; guarded so no op can be
+                # enqueued after it (see _submit)
+        for s in list(self._peer_socks.values()):
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._peer_socks = {}
+        if self._root_sock is not None:
+            try:
+                self._root_sock.close()
+            except OSError:
+                pass
+            self._root_sock = None
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    def errored(self) -> Optional[Exception]:
+        with self._lock:
+            return self._error
+
+    # ----------------------------------------------------------- collectives
+
+    def _submit(self, opcode: int, arrays: Sequence[np.ndarray], op: str,
+                root: int) -> Work:
+        fut: Future = Future()
+        fut.set_running_or_notify_cancel()
+        err = self.errored()
+        if err is not None:
+            fut.set_exception(
+                ConnectionError(f"comm context previously errored: {err}")
+            )
+            return Work(fut)
+        pending = _PendingOp(
+            opcode, [np.asarray(a) for a in arrays], op, root, fut
+        )
+        # Lock pairs with shutdown(): either we enqueue before the sentinel
+        # (op will be drained) or we observe _thread is None and fail fast.
+        with self._lock:
+            if self._thread is None:
+                fut.set_exception(
+                    RuntimeError("comm context not configured")
+                )
+                return Work(fut)
+            self._queue.put(pending)
+        return Work(fut)
+
+    def allreduce(
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
+    ) -> Work:
+        return self._submit(_OP_ALLREDUCE, arrays, op, 0)
+
+    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
+        return self._submit(_OP_ALLGATHER, arrays, ReduceOp.SUM, 0)
+
+    def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
+        return self._submit(_OP_BROADCAST, arrays, ReduceOp.SUM, root)
+
+    # ------------------------------------------------------ transport thread
+
+    def _run_loop(self) -> None:
+        while True:
+            pending = self._queue.get()
+            if pending is None:
+                return
+            try:
+                result = self._execute(pending)
+                pending.fut.set_result(result)
+            except Exception as e:  # noqa: BLE001 — latch every transport error
+                with self._lock:
+                    if self._error is None:
+                        self._error = e
+                logger.warning(
+                    "comm op failed (rank %d world %d): %s",
+                    self._rank, self._world_size, e,
+                )
+                try:
+                    pending.fut.set_exception(e)
+                except Exception:
+                    pass
+
+    def _execute(self, p: _PendingOp):
+        self._seq += 1
+        if self._world_size == 1:
+            if p.opcode == _OP_ALLGATHER:
+                return [p.arrays]
+            return p.arrays
+
+    # Star protocol frame (peer->root): [opcode u8][seq u64][op u8] + arrays.
+        if self._rank == 0:
+            return self._execute_root(p)
+        return self._execute_peer(p)
+
+    def _execute_root(self, p: _PendingOp):
+        contributions: Dict[int, List[np.ndarray]] = {0: p.arrays}
+        for peer_rank, sock in sorted(self._peer_socks.items()):
+            opcode, seq, _op = struct.unpack("<BQB", _recv_exact(sock, 10))
+            if opcode != p.opcode or seq != self._seq:
+                raise ConnectionError(
+                    f"collective mismatch from rank {peer_rank}: "
+                    f"got op={opcode} seq={seq}, expected op={p.opcode} "
+                    f"seq={self._seq}"
+                )
+            contributions[peer_rank] = _recv_arrays(sock)
+
+        if p.opcode == _OP_ALLREDUCE:
+            reduce_fn = _REDUCE_FNS.get(
+                ReduceOp.SUM if p.op == ReduceOp.AVG else p.op
+            )
+            if reduce_fn is None:
+                raise ValueError(f"unsupported reduce op: {p.op}")
+            acc = [
+                np.ascontiguousarray(a).astype(a.dtype, copy=True)
+                for a in p.arrays
+            ]
+            for r in range(1, self._world_size):
+                for i, a in enumerate(contributions[r]):
+                    reduce_fn(acc[i], a)
+            if p.op == ReduceOp.AVG:
+                for a in acc:
+                    np.divide(a, self._world_size, out=a)
+            for _, sock in sorted(self._peer_socks.items()):
+                _send_arrays(sock, acc)
+            return acc
+        if p.opcode == _OP_ALLGATHER:
+            gathered = [contributions[r] for r in range(self._world_size)]
+            flat: List[np.ndarray] = [
+                np.asarray(self._world_size, dtype=np.int64)
+            ]
+            for per_rank in gathered:
+                flat.append(np.asarray(len(per_rank), dtype=np.int64))
+                flat.extend(per_rank)
+            for _, sock in sorted(self._peer_socks.items()):
+                _send_arrays(sock, flat)
+            return gathered
+        if p.opcode == _OP_BROADCAST:
+            src = contributions[p.root]
+            for _, sock in sorted(self._peer_socks.items()):
+                _send_arrays(sock, src)
+            return [a.copy() for a in src]
+        raise ValueError(f"unknown opcode {p.opcode}")
+
+    def _execute_peer(self, p: _PendingOp):
+        sock = self._root_sock
+        assert sock is not None
+        sock.sendall(struct.pack("<BQB", p.opcode, self._seq, 0))
+        if p.opcode == _OP_BROADCAST and self._rank != p.root:
+            # Root discards non-root contributions for broadcast; send an
+            # empty frame instead of the full payload.
+            _send_arrays(sock, [])
+        else:
+            _send_arrays(sock, p.arrays)
+        result = _recv_arrays(sock)
+        if p.opcode == _OP_ALLGATHER:
+            # Decode the flattened [world, n_0, bufs_0..., n_1, ...] frame.
+            idx = 0
+            world = int(result[idx])
+            idx += 1
+            gathered: List[List[np.ndarray]] = []
+            for _ in range(world):
+                n = int(result[idx])
+                idx += 1
+                gathered.append(result[idx: idx + n])
+                idx += n
+            return gathered
+        return result

@@ -121,7 +121,10 @@ class Lighthouse:
     def __del__(self) -> None:
         handle, self._handle = getattr(self, "_handle", None), None
         if handle:
-            get_lib().ft_lighthouse_free(handle)
+            try:
+                get_lib().ft_lighthouse_free(handle)
+            except Exception:
+                pass  # interpreter teardown
 
 
 class ManagerServer:
@@ -184,7 +187,10 @@ class ManagerServer:
     def __del__(self) -> None:
         handle, self._handle = getattr(self, "_handle", None), None
         if handle:
-            get_lib().ft_manager_free(handle)
+            try:
+                get_lib().ft_manager_free(handle)
+            except Exception:
+                pass  # interpreter teardown
 
 
 class ManagerClient:
@@ -264,7 +270,10 @@ class ManagerClient:
     def __del__(self) -> None:
         handle, self._handle = getattr(self, "_handle", None), None
         if handle:
-            get_lib().ft_manager_client_free(handle)
+            try:
+                get_lib().ft_manager_client_free(handle)
+            except Exception:
+                pass  # interpreter teardown
 
 
 def lighthouse_heartbeat(

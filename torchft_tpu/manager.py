@@ -1,0 +1,577 @@
+"""Manager — the per-step fault-tolerance runtime.
+
+TPU-native re-design of the reference Manager state machine
+(/root/reference/torchft/manager.py:73-679). One Manager runs in every
+worker process of a replica group (on TPU: one process per host of a
+slice); rank 0 additionally embeds the native C++ manager server
+(torchft_tpu.control.ManagerServer) that talks to the global lighthouse.
+
+Per-step protocol (driven by the OptimizerWrapper, torchft_tpu/optim.py):
+
+    begin_step / start_quorum   — async quorum on a 1-thread executor,
+                                  overlapping the forward pass
+    allreduce(...)              — fault-tolerant cross-replica gradient
+                                  averaging over the DCN CommContext;
+                                  errors are latched, not raised
+    should_commit()             — drain pending work, two-phase commit
+                                  barrier; True ⇒ apply optimizer update
+
+JAX-specific surface: ``allreduce_pytree`` reduces an arbitrary pytree of
+jax/numpy arrays (device→host, reduce over DCN, host→device) and is the
+building block DDP-style wrappers use; the compiled in-group step function
+never sees the replica dimension, so quorum changes NEVER trigger a
+recompile — gradient normalization uses the runtime ``num_participants``
+scalar exactly like ref manager.py:287.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket as _socket
+import threading
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from datetime import timedelta
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, Sequence, TypeVar
+
+import numpy as np
+
+from torchft_tpu.checkpointing import CheckpointServer, CheckpointTransport
+from torchft_tpu.comm.context import (
+    CommContext,
+    CompletedWork,
+    ReduceOp,
+    Work,
+)
+from torchft_tpu.comm.store import StoreClient
+from torchft_tpu.control import ManagerClient, ManagerServer
+from torchft_tpu.futures import future_chain, future_timeout
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+MANAGER_ADDR_KEY: str = "manager_addr"
+REPLICA_ID_KEY: str = "replica_id"
+MANAGER_PORT_ENV: str = "TORCHFT_TPU_MANAGER_PORT"
+LIGHTHOUSE_ENV: str = "TORCHFT_TPU_LIGHTHOUSE"
+
+__all__ = ["Manager", "WorldSizeMode"]
+
+
+def _seconds(t: "float | timedelta") -> float:
+    return t.total_seconds() if isinstance(t, timedelta) else float(t)
+
+
+class WorldSizeMode(Enum):
+    """Numerics policy when more than ``min_replica_size`` replicas are
+    healthy (ref manager.py:55-70).
+
+    DYNAMIC: use every available replica; gradients normalized by the
+        actual participant count.
+    FIXED_WITH_SPARES: exactly ``min_replica_size`` replicas contribute;
+        spares run but contribute zero gradients.
+    """
+
+    DYNAMIC = 0
+    FIXED_WITH_SPARES = 1
+
+
+class Manager:
+    """Fault-tolerant training loop manager (ref manager.py:73-679).
+
+    Args mirror the reference ctor (manager.py:87-145): ``comm`` is the
+    cross-replica CommContext (the ProcessGroup analog), ``load_state_dict``
+    /``state_dict`` capture/restore the *user* training state (params,
+    optimizer state, dataloader position...).
+    """
+
+    def __init__(
+        self,
+        comm: CommContext,
+        load_state_dict: Optional[Callable[[T], None]],
+        state_dict: Optional[Callable[[], T]],
+        min_replica_size: int,
+        use_async_quorum: bool = True,
+        timeout: "float | timedelta" = 60.0,
+        quorum_timeout: "float | timedelta" = 60.0,
+        connect_timeout: "float | timedelta" = 60.0,
+        rank: Optional[int] = None,
+        world_size: Optional[int] = None,
+        world_size_mode: WorldSizeMode = WorldSizeMode.DYNAMIC,
+        store_addr: Optional[str] = None,
+        lighthouse_addr: Optional[str] = None,
+        replica_id: Optional[str] = None,
+        port: Optional[int] = None,
+        hostname: Optional[str] = None,
+        heartbeat_interval: "float | timedelta" = 0.1,
+        checkpoint_transport: Optional[CheckpointTransport] = None,
+    ) -> None:
+        self._load_state_dict = load_state_dict
+        self._user_state_dict = state_dict
+        self._pending_state_dict: Optional[Dict[str, Any]] = None
+        self._use_async_quorum = use_async_quorum
+        self._timeout = _seconds(timeout)
+        self._quorum_timeout = _seconds(quorum_timeout)
+        self._connect_timeout = _seconds(connect_timeout)
+        self._world_size_mode = world_size_mode
+        self._min_replica_size = min_replica_size
+
+        store_addr = store_addr or (
+            f"{os.environ['MASTER_ADDR']}:{os.environ['MASTER_PORT']}"
+        )
+        self._rank = rank if rank is not None else int(os.environ.get("RANK", "0"))
+        world_size = world_size or int(os.environ.get("WORLD_SIZE", "1"))
+        self._world_size = world_size
+
+        if checkpoint_transport is None:
+            checkpoint_transport = CheckpointServer(timeout=self._timeout)
+        self._checkpoint_transport = checkpoint_transport
+
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="async_quorum"
+        )
+        self._quorum_future: Optional[Future] = None
+
+        self._store = StoreClient(store_addr, connect_timeout=self._connect_timeout)
+        self._comm = comm
+        self._manager: Optional[ManagerServer] = None
+
+        if self._rank == 0:
+            if port is None:
+                port = int(os.environ.get(MANAGER_PORT_ENV, 0))
+            lighthouse_addr = lighthouse_addr or os.environ[LIGHTHOUSE_ENV]
+            replica_id = (replica_id or "") + str(uuid.uuid4())
+            self._manager = ManagerServer(
+                replica_id=replica_id,
+                lighthouse_addr=lighthouse_addr,
+                hostname=hostname or _socket.gethostname(),
+                bind=f"0.0.0.0:{port}",
+                store_addr=store_addr,
+                world_size=world_size,
+                heartbeat_interval=_seconds(heartbeat_interval),
+                connect_timeout=self._connect_timeout,
+            )
+            self._store.set(MANAGER_ADDR_KEY, self._manager.address())
+            self._store.set(REPLICA_ID_KEY, replica_id)
+
+        addr = self._store.wait(
+            MANAGER_ADDR_KEY, timeout=self._connect_timeout
+        ).decode()
+        self._client = ManagerClient(addr, connect_timeout=self._connect_timeout)
+        replica_id = self._store.wait(
+            REPLICA_ID_KEY, timeout=self._connect_timeout
+        ).decode()
+        self._replica_id = replica_id
+        self._logger = _ManagerLogger(self, replica_id, self._rank)
+
+        self._step = 0
+        self._quorum_id = -1
+        self._errored: Optional[Exception] = None
+        self._errored_lock = threading.Lock()
+        self._healing = False
+        self._pending_work: List[Future] = []
+        self._batches_committed = 0
+
+        self._participating_rank: Optional[int] = None
+        self._participating_world_size: int = 0
+
+    # ------------------------------------------------------------- lifecycle
+
+    def set_state_dict_fns(
+        self, load_state_dict: Callable[[T], None], state_dict: Callable[[], T]
+    ) -> None:
+        self._load_state_dict = load_state_dict
+        self._user_state_dict = state_dict
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Shutdown the manager server, checkpoint transport and comm."""
+        self._checkpoint_transport.shutdown(wait=wait)
+        if self._manager is not None:
+            self._manager.shutdown()
+        self._executor.shutdown(wait=wait)
+        self._comm.shutdown()
+
+    # ------------------------------------------------------------ collectives
+
+    def allreduce_arrays(
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
+    ) -> Work:
+        """Fault-tolerant cross-replica allreduce of host arrays, scaled by
+        1/num_participants (ref manager.py:242-303 semantics):
+
+        * after the first error this step, returns the input unchanged
+        * while healing / not participating, contributes zeros
+        * transport errors are latched, never raised — the future always
+          completes (with the corrupt-but-unused input as the default)
+        """
+        arrays = [np.asarray(a) for a in arrays]
+        if self.errored() is not None:
+            return CompletedWork(list(arrays))
+
+        try:
+            self.wait_quorum()
+        except Exception as e:  # quorum failed: latch and skip the step
+            # (hardening over the reference, which lets this propagate
+            # mid-backward — ref manager.py:397 TODO)
+            self._logger.exception(f"quorum failed in allreduce: {e}")
+            self.report_error(e)
+            return CompletedWork(list(arrays))
+
+        if not self.is_participating():
+            arrays = [np.zeros_like(a) for a in arrays]
+
+        try:
+            work = self._comm.allreduce(arrays, op)
+
+            def _normalize(f: Future) -> List[np.ndarray]:
+                reduced = f.result()  # raises into wrap future on error
+                if op != ReduceOp.SUM:
+                    # AVG is already divided by the transport; MAX/MIN must
+                    # not be scaled at all.
+                    return reduced
+                scale = 1.0 / max(1, self.num_participants())
+                return [
+                    (a * np.asarray(scale).astype(a.dtype))
+                    if np.issubdtype(a.dtype, np.floating)
+                    else a
+                    for a in reduced
+                ]
+
+            fut = future_chain(work.future(), _normalize)
+            return Work(self.wrap_future(fut, list(arrays)))
+        except Exception as e:  # noqa: BLE001
+            self._logger.exception(f"allreduce submit failed: {e}")
+            self.report_error(e)
+            return CompletedWork(list(arrays))
+
+    def allreduce_pytree(self, tree: Any, op: str = ReduceOp.SUM) -> Future:
+        """Reduce a pytree of jax/numpy arrays across replica groups.
+
+        Device arrays are fetched to host (async under jax dispatch),
+        reduced over DCN, and the future resolves to a pytree of numpy
+        arrays with the original structure. This is the DDP-comm-hook
+        analog for jax training steps (ref ddp.py:65-71)."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        host_leaves = [np.asarray(jax.device_get(x)) for x in leaves]
+        work = self.allreduce_arrays(host_leaves, op=op)
+        return future_chain(
+            work.future(),
+            lambda f: jax.tree_util.tree_unflatten(treedef, f.result()),
+        )
+
+    # ---------------------------------------------------------- error model
+
+    def report_error(self, e: Exception) -> None:
+        """Latch an error: the current step will not commit and the comm
+        context will be reconfigured on the next quorum (ref manager.py:305-315)."""
+        with self._errored_lock:
+            self._errored = e
+
+    def errored(self) -> Optional[Exception]:
+        with self._errored_lock:
+            return self._errored
+
+    def wrap_future(
+        self, fut: Future, default: Any,
+        timeout: "float | timedelta | None" = None,
+    ) -> Future:
+        """Add a timeout + error-swallow continuation: on failure the
+        future completes with ``default`` and the error is latched
+        (ref manager.py:326-363)."""
+        timed = future_timeout(fut, _seconds(timeout) if timeout else self._timeout)
+
+        def _swallow(f: Future) -> Any:
+            exc = f.exception()
+            if exc is None:
+                return f.result()
+            self._logger.exception(f"got exception in future: {exc}")
+            self.report_error(exc)  # type: ignore[arg-type]
+            return default
+
+        out = future_chain(timed, _swallow)
+        self._pending_work.append(out)
+        return out
+
+    # --------------------------------------------------------------- quorum
+
+    def start_quorum(
+        self,
+        allow_heal: bool = True,
+        shrink_only: bool = False,
+        timeout: "float | timedelta | None" = None,
+    ) -> None:
+        """Compute a new quorum (async by default, overlapping forward) and
+        ready the manager for a new step (ref manager.py:365-415)."""
+        if self._quorum_future is not None:
+            try:
+                self._quorum_future.result()
+            except Exception as e:  # previous quorum failed; a new one is
+                self._logger.exception(  # about to supersede it
+                    f"previous quorum failed, starting fresh: {e}"
+                )
+
+        with self._errored_lock:
+            self._errored = None
+        self._healing = False
+
+        self._quorum_future = self._executor.submit(
+            self._async_quorum,
+            allow_heal=allow_heal,
+            shrink_only=shrink_only,
+            quorum_timeout=_seconds(timeout) if timeout else self._quorum_timeout,
+        )
+        if not self._use_async_quorum:
+            self.wait_quorum()
+            if self._healing:
+                # sync mode: eagerly apply the fetched state so the forward
+                # pass runs on recovered weights (ref manager.py:409-415)
+                self._apply_pending_state_dict()
+                self._healing = False
+
+    def wait_quorum(self) -> None:
+        """Block until the in-flight quorum completes; the comm context is
+        configured for the new membership after this returns."""
+        assert self._quorum_future is not None, (
+            "must call start_quorum before wait_quorum"
+        )
+        self._quorum_future.result()
+
+    def _async_quorum(
+        self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
+    ) -> None:
+        quorum = self._client.quorum(
+            rank=self._rank,
+            step=self._step,
+            checkpoint_metadata=self._checkpoint_transport.metadata(),
+            shrink_only=shrink_only,
+            timeout=quorum_timeout,
+        )
+
+        # Async quorum: only the up-to-date (max-step) cohort participates —
+        # healing replicas contribute zeros this step. Sync quorum (or
+        # allow_heal=False): everyone in the quorum participates
+        # (ref manager.py:449-456).
+        self._participating_rank, self._participating_world_size = (
+            (quorum.max_rank, quorum.max_world_size)
+            if self._use_async_quorum or not allow_heal
+            else (quorum.replica_rank, quorum.replica_world_size)
+        )
+
+        if self._world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
+            # Spares contribute zero gradients (ref manager.py:460-468).
+            self._participating_world_size = min(
+                self._participating_world_size, self._min_replica_size
+            )
+            if (
+                self._participating_rank is not None
+                and self._participating_rank >= self._min_replica_size
+            ):
+                self._participating_rank = None
+
+        if quorum.quorum_id != self._quorum_id:
+            store_prefixed_addr = (
+                f"{quorum.store_address}/torchft/{quorum.quorum_id}/{self._rank}"
+            )
+            self._logger.info(
+                f"reconfiguring for quorum_id={quorum.quorum_id} "
+                f"store={store_prefixed_addr}"
+            )
+            try:
+                self._comm.configure(
+                    store_prefixed_addr, quorum.replica_rank,
+                    quorum.replica_world_size,
+                )
+                self._quorum_id = quorum.quorum_id
+            except Exception as e:  # noqa: BLE001
+                # A peer that died between quorum announcement and transport
+                # rendezvous lands here. Latch: this step is discarded and
+                # the UNCHANGED _quorum_id forces reconfiguration on the
+                # next quorum (hardening over ref manager.py:475 TODO).
+                self._logger.exception(f"comm configure failed: {e}")
+                self.report_error(e)
+
+        if allow_heal:
+            if quorum.recover_dst_ranks:
+                self._logger.info(
+                    f"peers need recovery from us {quorum.recover_dst_ranks}"
+                )
+                self._checkpoint_transport.send_checkpoint(
+                    dst_ranks=quorum.recover_dst_ranks,
+                    step=quorum.max_step,
+                    state_dict=self._manager_state_dict(),
+                    timeout=self._timeout,
+                )
+            if quorum.heal:
+                try:
+                    self._healing = True
+                    self._logger.info(
+                        f"healing required, fetching checkpoint metadata "
+                        f"from {quorum.recover_src_manager_address} "
+                        f"max_step={quorum.max_step}"
+                    )
+                    src_client = ManagerClient(
+                        quorum.recover_src_manager_address,
+                        connect_timeout=self._connect_timeout,
+                    )
+                    metadata = src_client.checkpoint_metadata(
+                        self._rank, timeout=self._timeout
+                    )
+                    assert quorum.recover_src_rank is not None, (
+                        "must have a recover rank when healing"
+                    )
+                    self._logger.info(
+                        f"fetching checkpoint from rank "
+                        f"{quorum.recover_src_rank} metadata={metadata}"
+                    )
+                    # The user state dict is applied later from the main
+                    # thread (should_commit) — only torchft state is loaded
+                    # here (ref manager.py:512-526).
+                    self._pending_state_dict = (
+                        self._checkpoint_transport.recv_checkpoint(
+                            src_rank=quorum.recover_src_rank,
+                            metadata=metadata,
+                            step=quorum.max_step,
+                            timeout=self._timeout,
+                        )
+                    )
+                    self.load_state_dict(self._pending_state_dict["torchft"])
+                    self._step = quorum.max_step
+                except Exception as e:  # noqa: BLE001
+                    # Donor vanished mid-heal: latch (this step votes False
+                    # and the next quorum retries the heal) instead of
+                    # raising out of should_commit via the quorum future.
+                    self._logger.exception(f"heal failed: {e}")
+                    self._healing = False
+                    self._pending_state_dict = None
+                    self.report_error(e)
+
+    def _apply_pending_state_dict(self) -> None:
+        assert self._healing, "must be in healing state"
+        assert self._quorum_future is not None, (
+            "must call start_quorum before should_commit"
+        )
+        self._quorum_future.result()
+        self._logger.info("applying pending state dict")
+        assert self._pending_state_dict is not None, "checkpoint was not staged"
+        assert self._load_state_dict is not None, (
+            "user load_state_dict is not initialized"
+        )
+        self._load_state_dict(self._pending_state_dict["user"])
+        self._pending_state_dict = None
+        self._logger.info("loaded state dict")
+
+    # ---------------------------------------------------------------- commit
+
+    def should_commit(self, timeout: "float | timedelta | None" = None) -> bool:
+        """Two-phase commit: drain pending collectives, apply a pending
+        heal, then vote across the local ranks of this replica group
+        (ref manager.py:545-598). True ⇒ the optimizer may be stepped."""
+        for work in self._pending_work:
+            if self.errored() is not None:
+                break
+            # Errors are swallowed into the latch by wrap_future; this never
+            # raises.
+            try:
+                work.result()
+            except Exception:  # pragma: no cover — defensive
+                pass
+        self._pending_work = []
+
+        if self._healing:
+            self._apply_pending_state_dict()
+
+        enough_replicas = self.num_participants() >= self._min_replica_size
+        local_should_commit = enough_replicas and self.errored() is None
+        should_commit = self._client.should_commit(
+            self._rank,
+            self._step,
+            local_should_commit,
+            timeout=_seconds(timeout) if timeout else self._timeout,
+        )
+        self._logger.info(
+            f"should_commit={should_commit} enough_replicas={enough_replicas} "
+            f"errored={self.errored()}"
+        )
+
+        self._checkpoint_transport.disallow_checkpoint()
+
+        if should_commit:
+            self._step += 1
+            self._batches_committed += self.num_participants()
+        return should_commit
+
+    # ----------------------------------------------------------------- state
+
+    def load_state_dict(self, state_dict: Dict[str, int]) -> None:
+        """Restore step count / batch bookkeeping from a checkpoint
+        (ref manager.py:600-610)."""
+        self._step = state_dict["step"]
+        self._batches_committed = state_dict["batches_committed"]
+
+    def _manager_state_dict(self) -> Dict[str, Any]:
+        assert self._user_state_dict is not None, (
+            "user state_dict is not initialized"
+        )
+        return {
+            "user": self._user_state_dict(),
+            "torchft": self.state_dict(),
+        }
+
+    def state_dict(self) -> Dict[str, int]:
+        return {"step": self._step, "batches_committed": self._batches_committed}
+
+    def current_step(self) -> int:
+        return self._step
+
+    def batches_committed(self) -> int:
+        return self._batches_committed
+
+    def num_participants(self) -> int:
+        assert self._participating_world_size >= 0, "internal error"
+        return self._participating_world_size
+
+    def participating_rank(self) -> Optional[int]:
+        return self._participating_rank
+
+    def is_participating(self) -> bool:
+        """False while healing or parked as a spare — such replicas
+        contribute zero gradients (ref manager.py:667-679)."""
+        if self._participating_rank is None:
+            return False
+        if self._healing:
+            assert self._use_async_quorum
+            return False
+        return True
+
+    def replica_id(self) -> str:
+        return self._replica_id
+
+
+class _ManagerLogger:
+    """Per-replica `[replica/rank - step N]` log prefixing (ref manager.py:682-701)."""
+
+    def __init__(self, manager: Manager, replica_id: str, rank: int) -> None:
+        self._logger = logging.getLogger(__name__)
+        self._replica_id = replica_id
+        self._rank = rank
+        self._manager = manager
+
+    def prefix(self) -> str:
+        return (
+            f"[{self._replica_id}/{self._rank} - "
+            f"step {self._manager.current_step()}]"
+        )
+
+    def info(self, msg: str) -> None:
+        self._logger.info(f"{self.prefix()} {msg}")
+
+    def warn(self, msg: str) -> None:
+        self._logger.warning(f"{self.prefix()} {msg}")
+
+    def exception(self, msg: str) -> None:
+        self._logger.exception(f"{self.prefix()} {msg}")

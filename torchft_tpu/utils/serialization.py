@@ -1,0 +1,61 @@
+"""Pytree ↔ bytes serialization for live checkpoint streaming.
+
+The reference streams torch.save pickles over HTTP
+(/root/reference/torchft/checkpointing.py:135-203). Here the payload is a
+JAX pytree (params/opt-state/step metadata): jax.Arrays are converted to
+numpy on the way out (device→host DMA) and pickled with protocol 5 so large
+leaf buffers ride as contiguous frames. The receiving side gets numpy
+leaves; trainer wrappers put them back on device with the right sharding
+(device_put with a NamedSharding) — which is exactly the hook needed for
+sharding-aware HSDP healing.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, BinaryIO
+
+import numpy as np
+
+__all__ = ["pytree_to_stream", "pytree_from_stream", "pytree_to_bytes",
+           "pytree_from_bytes", "to_host"]
+
+
+def to_host(tree: Any) -> Any:
+    """Convert all jax.Array leaves to numpy (device→host)."""
+    import jax
+
+    def _leaf(x: Any) -> Any:
+        if isinstance(x, jax.Array):
+            return np.asarray(jax.device_get(x))
+        return x
+
+    return jax.tree_util.tree_map(_leaf, tree)
+
+
+def pytree_to_stream(tree: Any, stream: BinaryIO, convert: bool = True) -> None:
+    """Serialize a pytree into a binary stream (host copies of all leaves).
+
+    Pass ``convert=False`` when the tree is already all-host (e.g. a staged
+    checkpoint copy) to skip a redundant tree_map over every leaf.
+
+    SECURITY: the payload is a pickle, so the checkpoint plane must only
+    span mutually trusted trainer hosts — the same trust model as the
+    reference's torch.load(weights_only=False) (ref checkpointing.py:203).
+    """
+    pickle.dump(to_host(tree) if convert else tree, stream, protocol=5)
+
+
+def pytree_from_stream(stream: BinaryIO) -> Any:
+    return pickle.load(stream)
+
+
+def pytree_to_bytes(tree: Any) -> bytes:
+    buf = io.BytesIO()
+    pytree_to_stream(tree, buf)
+    return buf.getvalue()
+
+
+def pytree_from_bytes(data: bytes) -> Any:
+    return pytree_from_stream(io.BytesIO(data))
