@@ -1,13 +1,30 @@
-"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
-import so every test can build multi-device meshes without TPU hardware
-(the pattern recommended for CI in SURVEY.md §4)."""
+"""Test configuration: force an 8-device virtual CPU platform so every test
+can build multi-device meshes without TPU hardware (SURVEY.md §4 pattern).
+
+This environment ships an 'axon' PJRT plugin (registered by a sitecustomize
+before pytest starts) that tunnels to a SINGLE-tenant TPU chip. Tests must
+never initialize it: (a) the tunnel admits one process at a time, so a test
+run would deadlock against the bench/driver, and (b) multi-device tests
+need 8 devices. jax is already partially imported by the sitecustomize, so
+env vars alone don't stick — override the config and deregister the axon
+factory before any backend is instantiated.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge
+
+    xla_bridge._backend_factories.pop("axon", None)
+except Exception:  # pragma: no cover — jax internals moved; cpu config holds
+    pass

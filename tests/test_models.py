@@ -1,0 +1,132 @@
+"""Model tests: transformer forward/train numerics, sharded variants,
+ResNet-18, toy MLP."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from torchft_tpu.models import (
+    CONFIGS,
+    count_params,
+    forward,
+    init_params,
+    init_linear,
+    linear_forward,
+    loss_fn,
+    make_train_step,
+)
+from torchft_tpu.parallel import ft_mesh, make_ring_attention, shard_pytree, tp_rules_gpt
+
+
+TINY = CONFIGS["tiny"]
+
+
+def _data(cfg, batch=2, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq_len)),
+        dtype=jnp.int32,
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+    return tokens, targets
+
+
+def test_transformer_forward_shapes_and_param_count() -> None:
+    params = init_params(TINY, jax.random.key(0))
+    tokens, _ = _data(TINY)
+    logits = forward(TINY, params, tokens)
+    assert logits.shape == (2, TINY.max_seq_len, TINY.vocab_size)
+    assert logits.dtype == jnp.float32
+    n = count_params(params)
+    assert n > 100_000  # tiny config ~ a few hundred k
+
+
+def test_transformer_train_step_reduces_loss() -> None:
+    params = init_params(TINY, jax.random.key(0))
+    tx = optax.adam(1e-2)
+    step = make_train_step(TINY, tx, donate=False)
+    opt_state = tx.init(params)
+    tokens, targets = _data(TINY)
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_transformer_125m_param_count() -> None:
+    # structural check without materializing: shape-only eval
+    cfg = CONFIGS["125m"]
+    shapes = jax.eval_shape(
+        lambda k: init_params(cfg, k), jax.random.key(0)
+    )
+    n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(shapes))
+    assert 120e6 < n < 180e6, n
+
+
+def test_transformer_sharded_dp_fsdp_tp() -> None:
+    # full train step over a data×fsdp×tensor mesh, tiny shapes
+    mesh = ft_mesh({"data": 2, "fsdp": 2, "tensor": 2})
+    params = init_params(TINY, jax.random.key(0))
+    params = shard_pytree(params, mesh, tp_rules=tp_rules_gpt())
+    tx = optax.sgd(1e-2)
+    step = make_train_step(TINY, tx, donate=False)
+    opt_state = tx.init(params)
+    tokens, targets = _data(TINY, batch=4)
+    batch_sharding = NamedSharding(mesh, P("data", None))
+    tokens = jax.device_put(tokens, batch_sharding)
+    targets = jax.device_put(targets, batch_sharding)
+    params2, opt_state2, loss = step(params, opt_state, tokens, targets)
+    assert np.isfinite(float(loss))
+
+    # numerics match the unsharded step
+    params_r = init_params(TINY, jax.random.key(0))
+    opt_r = tx.init(params_r)
+    _, _, loss_r = make_train_step(TINY, tx, donate=False)(
+        params_r, opt_r, jax.device_get(tokens), jax.device_get(targets)
+    )
+    np.testing.assert_allclose(float(loss), float(loss_r), rtol=2e-2)
+
+
+def test_transformer_ring_attention_matches_local() -> None:
+    mesh = ft_mesh({"seq": 8})
+    cfg = TINY
+    params = init_params(cfg, jax.random.key(1))
+    tokens, targets = _data(cfg)
+    ring_fn = make_ring_attention(mesh, "seq", causal=True)
+
+    loss_local = loss_fn(cfg, params, tokens, targets)
+    with mesh:
+        loss_ring = jax.jit(
+            lambda p, t, y: loss_fn(cfg, p, t, y, attn_fn=ring_fn)
+        )(params, tokens, targets)
+    np.testing.assert_allclose(
+        float(loss_ring), float(loss_local), rtol=5e-3
+    )
+
+
+def test_linear_toy() -> None:
+    params = init_linear(jax.random.key(0), 2, 3)
+    out = linear_forward(params, jnp.ones((4, 2)))
+    assert out.shape == (4, 3)
+
+
+def test_resnet18_forward_and_step() -> None:
+    flax = pytest.importorskip("flax")
+    from torchft_tpu.models.resnet import create_resnet18
+
+    model, variables = create_resnet18(jax.random.key(0))
+    x = jnp.ones((2, 32, 32, 3))
+    logits, _ = model.apply(
+        variables, x, train=True, mutable=["batch_stats"]
+    )
+    assert logits.shape == (2, 10)
+    n = sum(
+        int(np.prod(l.shape))
+        for l in jax.tree_util.tree_leaves(variables["params"])
+    )
+    assert 10e6 < n < 13e6  # ResNet-18 ~11M params

@@ -13,8 +13,52 @@ Public API parity target: ref torchft/__init__.py:7-20.
 
 __version__ = "0.1.0"
 
+from torchft_tpu.checkpointing import (  # noqa: F401
+    CheckpointServer,
+    CheckpointTransport,
+)
+from torchft_tpu.comm.context import (  # noqa: F401
+    CommContext,
+    DummyCommContext,
+    ErrorSwallowingCommContext,
+    ManagedCommContext,
+    ReduceOp,
+)
+from torchft_tpu.comm.transport import TcpCommContext  # noqa: F401
+from torchft_tpu.data import DistributedSampler  # noqa: F401
+from torchft_tpu.ddp import (  # noqa: F401
+    DistributedDataParallel,
+    PureDistributedDataParallel,
+)
 from torchft_tpu.futures import (  # noqa: F401
     future_chain,
     future_timeout,
     future_wait,
 )
+from torchft_tpu.local_sgd import DiLoCo, LocalSGD  # noqa: F401
+from torchft_tpu.manager import Manager, WorldSizeMode  # noqa: F401
+from torchft_tpu.optim import OptimizerWrapper as Optimizer  # noqa: F401
+from torchft_tpu.optim import OptimizerWrapper  # noqa: F401
+
+__all__ = [
+    "CheckpointServer",
+    "CheckpointTransport",
+    "CommContext",
+    "DiLoCo",
+    "DistributedDataParallel",
+    "DistributedSampler",
+    "DummyCommContext",
+    "ErrorSwallowingCommContext",
+    "LocalSGD",
+    "ManagedCommContext",
+    "Manager",
+    "Optimizer",
+    "OptimizerWrapper",
+    "PureDistributedDataParallel",
+    "ReduceOp",
+    "TcpCommContext",
+    "WorldSizeMode",
+    "future_chain",
+    "future_timeout",
+    "future_wait",
+]

@@ -1,0 +1,202 @@
+"""Fault-tolerant LocalSGD and DiLoCo for JAX training loops.
+
+Reference: /root/reference/torchft/local_sgd.py:26-239. Both algorithms run
+``sync_every`` local optimizer steps between cross-replica syncs, keep a
+host-side backup of the params to roll back failed syncs, and compute the
+quorum only at sync points (so ``quorum_timeout`` must cover sync_every
+steps, ref manager.py:127-133).
+
+JAX rendering: params are pytrees owned by the training loop, so instead of
+optimizer hooks these are step-driven objects:
+
+    local = LocalSGD(manager, sync_every=8)
+    params = local.register(params)
+    for batch in data:
+        params, opt_state = inner_step(params, opt_state, batch)
+        params = local.step(params)     # syncs every 8th call
+
+DiLoCo (https://arxiv.org/pdf/2311.08105) additionally applies an *outer*
+optax transformation to the averaged pseudogradient. NOTE on sign: the
+pseudogradient here is ``backup - params`` (θ_old − θ_new, the paper's
+outer gradient). The reference snapshot computes the negation
+(p.data − backup, ref local_sgd.py:211-215) and would therefore *ascend*
+with a plain SGD outer optimizer — we implement the paper-correct sign.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+import numpy as np
+
+from torchft_tpu.comm.context import ReduceOp
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["LocalSGD", "DiLoCo"]
+
+
+def _to_host_copy(tree: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: np.array(jax.device_get(x), copy=True), tree
+    )
+
+
+class LocalSGD:
+    """Infrequent-sync data parallelism with rollback
+    (ref local_sgd.py:26-174)."""
+
+    def __init__(self, manager, sync_every: int,
+                 params_fn: Optional[Any] = None) -> None:
+        """``params_fn``: zero-arg callable returning the CURRENT params —
+        the same state the Manager's user ``load_state_dict`` writes into.
+        Needed for heal: the torch reference mutates the model in place
+        (ref local_sgd.py), but params here are caller-owned values, so
+        after a sync-quorum heal the wrapper must re-read them. Without it,
+        a rejoined replica would average its stale params into the group."""
+        assert sync_every >= 1, "sync_every must be >= 1"
+        self._manager = manager
+        self._sync_every = sync_every
+        self._params_fn = params_fn
+        self._local_step = 0
+        self._backup: Optional[Any] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def register(self, params: Any) -> Any:
+        """Save the initial backup (ref local_sgd.py:95 saves in ctor)."""
+        self._save_backup(params)
+        return params
+
+    def __enter__(self) -> "LocalSGD":
+        return self
+
+    def __exit__(self, exc_type, exc_value, traceback) -> bool:
+        # Exceptions roll the caller back to the last synced state via
+        # restore() (ref local_sgd.py:104-119); params are caller-owned in
+        # JAX so we only expose the restore point.
+        return False
+
+    def _save_backup(self, params: Any) -> None:
+        self._backup = _to_host_copy(params)
+
+    def restore(self) -> Any:
+        """The last committed (synced) params, as device arrays."""
+        import jax.numpy as jnp
+        import jax
+
+        assert self._backup is not None, "register() was never called"
+        return jax.tree_util.tree_map(jnp.asarray, self._backup)
+
+    @property
+    def local_step(self) -> int:
+        return self._local_step
+
+    # -- stepping -----------------------------------------------------------
+
+    def step(self, params: Any) -> Any:
+        """Count one inner optimizer step; sync on the sync_every boundary
+        (ref local_sgd.py:133-149)."""
+        if self._backup is None:
+            self._save_backup(params)
+        self._local_step += 1
+        if self._local_step >= self._sync_every:
+            return self.sync(params)
+        return params
+
+    def sync(self, params: Any) -> Any:
+        """Average params across replica groups; commit or roll back."""
+        self._manager.start_quorum()
+        if self._manager.did_heal():
+            # Sync-quorum heal applied a peer's checkpoint via the user
+            # load_state_dict; averaging must start from THAT state, not
+            # the caller's stale params (see ctor docstring).
+            if self._params_fn is not None:
+                params = self._params_fn()
+                self._save_backup(params)
+            else:
+                logger.warning(
+                    "healed without params_fn: caller params may be stale "
+                    "— pass params_fn to LocalSGD/DiLoCo for correct heal"
+                )
+        params = self._perform_sync(params)
+        self._local_step = 0
+        return params
+
+    def _perform_sync(self, params: Any) -> Any:
+        """Average weights; commit → new backup, abort → restore backup
+        (ref local_sgd.py:151-162)."""
+        import jax
+
+        avg_fut = self._manager.allreduce_pytree(params)
+        averaged = avg_fut.result()  # numpy pytree (errors latched → input)
+        if self._manager.should_commit():
+            import jax.numpy as jnp
+
+            new_params = jax.tree_util.tree_map(jnp.asarray, averaged)
+            self._save_backup(new_params)
+            return new_params
+        logger.warning("LocalSGD sync aborted; rolling back %d local steps",
+                       self._sync_every)
+        return self.restore()
+
+
+class DiLoCo(LocalSGD):
+    """Outer/inner-optimizer DP: average pseudogradients, apply an outer
+    optax step (ref local_sgd.py:177-239)."""
+
+    def __init__(self, manager, outer_tx, sync_every: int,
+                 params_fn: Optional[Any] = None) -> None:
+        if manager._use_async_quorum:
+            raise ValueError(
+                "DiLoCo requires synchronous quorum: construct the Manager "
+                "with use_async_quorum=False (ref local_sgd.py:195-199)"
+            )
+        super().__init__(manager, sync_every, params_fn=params_fn)
+        self._outer_tx = outer_tx
+        self._outer_state: Optional[Any] = None
+
+    def register(self, params: Any) -> Any:
+        params = super().register(params)
+        self._outer_state = self._outer_tx.init(params)
+        return params
+
+    @property
+    def outer_state(self) -> Any:
+        return self._outer_state
+
+    def load_outer_state(self, state: Any) -> None:
+        self._outer_state = state
+
+    def _perform_sync(self, params: Any) -> Any:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        assert self._backup is not None, "register() was never called"
+        # Outer gradient Δ = θ_old − θ_new (paper sign; see module note).
+        pseudograd = jax.tree_util.tree_map(
+            lambda old, new: np.asarray(old, dtype=np.float32)
+            - np.asarray(jax.device_get(new), dtype=np.float32),
+            self._backup,
+            params,
+        )
+        avg_fut = self._manager.allreduce_pytree(pseudograd)
+        averaged = avg_fut.result()
+
+        # Restore to the last synced point; the outer step moves from there
+        # (ref local_sgd.py:216-225).
+        params = self.restore()
+        if self._manager.should_commit():
+            grads = jax.tree_util.tree_map(jnp.asarray, averaged)
+            updates, self._outer_state = self._outer_tx.update(
+                grads, self._outer_state, params
+            )
+            params = optax.apply_updates(params, updates)
+            self._save_backup(params)
+        else:
+            logger.warning("DiLoCo sync aborted; rolling back")
+        return params

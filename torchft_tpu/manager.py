@@ -177,6 +177,8 @@ class Manager:
 
         self._participating_rank: Optional[int] = None
         self._participating_world_size: int = 0
+        self._replica_world_size: int = 0
+        self._did_heal = False
 
     # ------------------------------------------------------------- lifecycle
 
@@ -318,6 +320,7 @@ class Manager:
         with self._errored_lock:
             self._errored = None
         self._healing = False
+        self._did_heal = False
 
         self._quorum_future = self._executor.submit(
             self._async_quorum,
@@ -361,6 +364,7 @@ class Manager:
             if self._use_async_quorum or not allow_heal
             else (quorum.replica_rank, quorum.replica_world_size)
         )
+        self._replica_world_size = quorum.replica_world_size
 
         if self._world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
             # Spares contribute zero gradients (ref manager.py:460-468).
@@ -463,6 +467,7 @@ class Manager:
         )
         self._load_state_dict(self._pending_state_dict["user"])
         self._pending_state_dict = None
+        self._did_heal = True
         self._logger.info("loaded state dict")
 
     # ---------------------------------------------------------------- commit
@@ -534,6 +539,21 @@ class Manager:
     def num_participants(self) -> int:
         assert self._participating_world_size >= 0, "internal error"
         return self._participating_world_size
+
+    def did_heal(self) -> bool:
+        """True once this step's fetched checkpoint has been applied via
+        the user load_state_dict (reset by the next start_quorum). Lets
+        functional wrappers (LocalSGD/DiLoCo) re-read healed state that
+        the torch reference would have mutated in place."""
+        return self._did_heal
+
+    def replica_world_size(self) -> int:
+        """Total replicas in the current quorum (participating + healing).
+        When this is 1 there is no peer to reduce with, so gradient
+        averaging is an identity — wrappers use this to skip the
+        device→host→DCN round trip entirely (a fast path the reference
+        lacks: its single-replica jobs still run a loopback PG allreduce)."""
+        return self._replica_world_size
 
     def participating_rank(self) -> Optional[int]:
         return self._participating_rank

@@ -1,0 +1,16 @@
+from torchft_tpu.models.mlp import (  # noqa: F401
+    init_linear,
+    init_mlp,
+    linear_forward,
+    mlp_forward,
+)
+from torchft_tpu.models.transformer import (  # noqa: F401
+    CONFIGS,
+    TransformerConfig,
+    count_params,
+    forward,
+    init_params,
+    loss_fn,
+    make_grad_step,
+    make_train_step,
+)

@@ -1,0 +1,40 @@
+"""Toy models for examples and tests (BASELINE config #1: the train_ddp.py
+Linear(2,3)-class model)."""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["init_linear", "linear_forward", "init_mlp", "mlp_forward"]
+
+
+def init_linear(key, in_dim: int = 2, out_dim: int = 3) -> Dict:
+    kw, kb = jax.random.split(key)
+    return {
+        "kernel": jax.random.normal(kw, (in_dim, out_dim)) * 0.1,
+        "bias": jnp.zeros((out_dim,)),
+    }
+
+
+def linear_forward(params: Dict, x):
+    return x @ params["kernel"] + params["bias"]
+
+
+def init_mlp(key, dims: Sequence[int]) -> Dict:
+    params = {}
+    keys = jax.random.split(key, len(dims) - 1)
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        params[f"dense_{i}"] = init_linear(keys[i], d_in, d_out)
+    return params
+
+
+def mlp_forward(params: Dict, x):
+    n = len(params)
+    for i in range(n):
+        x = linear_forward(params[f"dense_{i}"], x)
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x

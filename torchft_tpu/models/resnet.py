@@ -1,0 +1,78 @@
+"""ResNet-18 for CIFAR-10 (BASELINE config #2: the reference's train_ddp.py
+example family, /root/reference/train_ddp.py:33-156, which trains a small
+CNN; we provide the full ResNet-18 in flax.linen)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import jax.numpy as jnp
+
+try:
+    import flax.linen as nn
+except ImportError:  # pragma: no cover
+    nn = None
+
+__all__ = ["ResNet18", "create_resnet18"]
+
+if nn is not None:
+
+    class ResidualBlock(nn.Module):
+        channels: int
+        strides: Tuple[int, int] = (1, 1)
+        dtype: Any = jnp.float32
+
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            residual = x
+            y = nn.Conv(self.channels, (3, 3), self.strides, padding=1,
+                        use_bias=False, dtype=self.dtype)(x)
+            y = nn.BatchNorm(use_running_average=not train,
+                             dtype=self.dtype)(y)
+            y = nn.relu(y)
+            y = nn.Conv(self.channels, (3, 3), padding=1, use_bias=False,
+                        dtype=self.dtype)(y)
+            y = nn.BatchNorm(use_running_average=not train,
+                             dtype=self.dtype)(y)
+            if residual.shape != y.shape:
+                residual = nn.Conv(self.channels, (1, 1), self.strides,
+                                   use_bias=False, dtype=self.dtype)(residual)
+                residual = nn.BatchNorm(
+                    use_running_average=not train, dtype=self.dtype
+                )(residual)
+            return nn.relu(y + residual)
+
+    class ResNet18(nn.Module):
+        num_classes: int = 10
+        dtype: Any = jnp.float32
+
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            # CIFAR stem: 3x3, no max-pool (32x32 inputs)
+            x = nn.Conv(64, (3, 3), padding=1, use_bias=False,
+                        dtype=self.dtype)(x)
+            x = nn.BatchNorm(use_running_average=not train,
+                             dtype=self.dtype)(x)
+            x = nn.relu(x)
+            for channels, strides in (
+                (64, (1, 1)), (64, (1, 1)),
+                (128, (2, 2)), (128, (1, 1)),
+                (256, (2, 2)), (256, (1, 1)),
+                (512, (2, 2)), (512, (1, 1)),
+            ):
+                x = ResidualBlock(channels, strides, self.dtype)(x, train)
+            x = jnp.mean(x, axis=(1, 2))
+            return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+    def create_resnet18(key, num_classes: int = 10, dtype=jnp.float32):
+        """Returns (model, variables) initialized for CIFAR-shaped input."""
+        model = ResNet18(num_classes=num_classes, dtype=dtype)
+        variables = model.init(key, jnp.zeros((1, 32, 32, 3), dtype),
+                               train=False)
+        return model, variables
+
+else:  # pragma: no cover
+
+    def create_resnet18(*a, **kw):
+        raise ImportError("flax is required for ResNet18")

@@ -1,0 +1,225 @@
+"""GPT-style decoder-only transformer — the framework's flagship model.
+
+Pure-jax (explicit param pytree, no module framework): parameter paths are
+stable strings, which the TP/FSDP sharding rules key on
+(parallel/sharding.py tp_rules_gpt), and everything the train step touches
+is visible in one place. Design choices are TPU-first:
+
+- bf16 activations/matmuls (MXU-native), f32 params + optimizer state
+- all shapes static; per-layer ``jax.checkpoint`` (remat) to trade HBM for
+  FLOPs at long sequence lengths
+- attention pluggable: local causal attention (fused by XLA) or ring
+  attention over a ``seq`` mesh axis for long-context (parallel/ring.py)
+
+The reference framework has no model zoo (its examples train torchvision
+models); the BASELINE configs require a 125M/1B transformer family, defined
+here via ``TransformerConfig`` presets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["TransformerConfig", "init_params", "forward", "loss_fn",
+           "make_train_step", "count_params", "CONFIGS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 1024
+    dtype: Any = jnp.bfloat16   # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    attention: str = "local"    # "local" | "ring"
+    seq_axis: str = "seq"       # mesh axis for ring attention
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+CONFIGS: Dict[str, TransformerConfig] = {
+    "tiny": TransformerConfig(
+        vocab_size=512, d_model=64, n_layers=2, n_heads=4, d_ff=256,
+        max_seq_len=128, remat=False,
+    ),
+    "125m": TransformerConfig(
+        vocab_size=32768, d_model=768, n_layers=12, n_heads=12, d_ff=3072,
+        max_seq_len=1024,
+    ),
+    "350m": TransformerConfig(
+        vocab_size=32768, d_model=1024, n_layers=24, n_heads=16, d_ff=4096,
+        max_seq_len=1024,
+    ),
+    "1b": TransformerConfig(
+        vocab_size=32768, d_model=2048, n_layers=24, n_heads=16, d_ff=8192,
+        max_seq_len=2048,
+    ),
+}
+
+
+def init_params(cfg: TransformerConfig, key) -> Dict:
+    """Initialize the parameter pytree. Path names (wte/wpe, layers_i/attn/
+    {q,k,v,o}_proj, mlp/{up,down}_proj, ln_f) are load-bearing: the TP rules
+    in parallel/sharding.py match on them."""
+    keys = jax.random.split(key, cfg.n_layers + 3)
+    pd = cfg.param_dtype
+    d, h, f = cfg.d_model, cfg.n_heads, cfg.d_ff
+
+    def dense(k, fan_in, fan_out):
+        scale = 1.0 / np.sqrt(fan_in)
+        return (jax.random.normal(k, (fan_in, fan_out), pd) * scale)
+
+    params: Dict[str, Any] = {
+        "wte": {"embedding": jax.random.normal(
+            keys[0], (cfg.vocab_size, d), pd) * 0.02},
+        "wpe": {"embedding": jax.random.normal(
+            keys[1], (cfg.max_seq_len, d), pd) * 0.02},
+        "ln_f": {"scale": jnp.ones((d,), pd), "bias": jnp.zeros((d,), pd)},
+        "lm_head": {"kernel": dense(keys[2], d, cfg.vocab_size)},
+    }
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(keys[3 + i], 6)
+        params[f"layers_{i}"] = {
+            "ln_1": {"scale": jnp.ones((d,), pd), "bias": jnp.zeros((d,), pd)},
+            "attn": {
+                "q_proj": {"kernel": dense(lk[0], d, d)},
+                "k_proj": {"kernel": dense(lk[1], d, d)},
+                "v_proj": {"kernel": dense(lk[2], d, d)},
+                "o_proj": {"kernel": dense(lk[3], d, d)},
+            },
+            "ln_2": {"scale": jnp.ones((d,), pd), "bias": jnp.zeros((d,), pd)},
+            "mlp": {
+                "up_proj": {"kernel": dense(lk[4], d, f)},
+                "down_proj": {"kernel": dense(lk[5], f, d)},
+            },
+        }
+    return params
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(
+        x.dtype
+    )
+
+
+def _local_causal_attention(q, k, v):
+    """[B,S,H,D] in, XLA-fused causal softmax attention (flash-pattern is
+    handled by ops/attention.py's pallas path on real TPU)."""
+    from torchft_tpu.ops.attention import causal_attention
+
+    return causal_attention(q, k, v)
+
+
+def _block(cfg: TransformerConfig, layer: Dict, x, *, attn_fn):
+    dt = cfg.dtype
+    h = _layer_norm(x, layer["ln_1"]["scale"], layer["ln_1"]["bias"])
+    B, S, _ = h.shape
+    q = (h @ layer["attn"]["q_proj"]["kernel"].astype(dt)).reshape(
+        B, S, cfg.n_heads, cfg.head_dim
+    )
+    k = (h @ layer["attn"]["k_proj"]["kernel"].astype(dt)).reshape(
+        B, S, cfg.n_heads, cfg.head_dim
+    )
+    v = (h @ layer["attn"]["v_proj"]["kernel"].astype(dt)).reshape(
+        B, S, cfg.n_heads, cfg.head_dim
+    )
+    a = attn_fn(q, k, v).reshape(B, S, cfg.d_model)
+    x = x + a @ layer["attn"]["o_proj"]["kernel"].astype(dt)
+
+    h = _layer_norm(x, layer["ln_2"]["scale"], layer["ln_2"]["bias"])
+    h = h @ layer["mlp"]["up_proj"]["kernel"].astype(dt)
+    h = jax.nn.gelu(h)
+    x = x + h @ layer["mlp"]["down_proj"]["kernel"].astype(dt)
+    return x
+
+
+def forward(
+    cfg: TransformerConfig,
+    params: Dict,
+    tokens,
+    attn_fn: Optional[Callable] = None,
+) -> Any:
+    """tokens [B,S] int32 -> logits [B,S,vocab] (f32)."""
+    if attn_fn is None:
+        attn_fn = _local_causal_attention
+    B, S = tokens.shape
+    dt = cfg.dtype
+    x = params["wte"]["embedding"].astype(dt)[tokens]
+    x = x + params["wpe"]["embedding"].astype(dt)[jnp.arange(S)][None, :, :]
+
+    block = functools.partial(_block, cfg, attn_fn=attn_fn)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+    for i in range(cfg.n_layers):
+        x = block(params[f"layers_{i}"], x)
+
+    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    logits = x.astype(jnp.float32) @ params["lm_head"]["kernel"].astype(
+        jnp.float32
+    )
+    return logits
+
+
+def loss_fn(cfg: TransformerConfig, params, tokens, targets,
+            attn_fn: Optional[Callable] = None):
+    """Mean next-token cross entropy."""
+    logits = forward(cfg, params, tokens, attn_fn)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def make_train_step(cfg: TransformerConfig, tx,
+                    attn_fn: Optional[Callable] = None,
+                    donate: bool = True):
+    """Jitted (params, opt_state, tokens, targets) -> (params, opt_state,
+    loss). The replica dimension does not exist here — cross-replica
+    averaging happens outside on the grad pytree (ddp.py) so quorum changes
+    never recompile this function."""
+    import optax
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens, targets, attn_fn)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def make_grad_step(cfg: TransformerConfig,
+                   attn_fn: Optional[Callable] = None):
+    """Jitted (params, tokens, targets) -> (loss, grads): the FT-DDP path
+    computes grads on-device, averages them across replica groups over DCN,
+    then applies the optimizer behind the commit gate."""
+
+    def step(params, tokens, targets):
+        return jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens, targets, attn_fn)
+        )(params)
+
+    return jax.jit(step)

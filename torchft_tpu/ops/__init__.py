@@ -1,0 +1,4 @@
+from torchft_tpu.ops.attention import (  # noqa: F401
+    causal_attention,
+    reference_attention,
+)

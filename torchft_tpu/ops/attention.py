@@ -1,0 +1,56 @@
+"""Attention ops: XLA path everywhere, pallas flash kernel on real TPU.
+
+The local (per-device) causal attention used by models/transformer.py.
+On CPU (tests) and as numerical reference, a plain einsum-softmax that XLA
+fuses; on TPU the pallas flash-attention kernel (ops/flash.py) streams KV
+blocks through VMEM without materializing the [S,S] score matrix.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["causal_attention", "reference_attention"]
+
+
+def reference_attention(q, k, v, causal: bool = True,
+                        scale: Optional[float] = None):
+    """[B,S,H,D] einsum attention (fp32 softmax)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return out
+
+
+def _use_pallas() -> bool:
+    if os.environ.get("TORCHFT_TPU_DISABLE_PALLAS"):
+        return False
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:  # pragma: no cover
+        return False
+
+
+def causal_attention(q, k, v, scale: Optional[float] = None):
+    """Dispatch: pallas flash kernel on TPU, reference path elsewhere."""
+    if _use_pallas():
+        try:
+            from torchft_tpu.ops.flash import flash_attention
+
+            return flash_attention(q, k, v, causal=True, scale=scale)
+        except Exception:  # pragma: no cover — kernel unavailable: fall back
+            pass
+    return reference_attention(q, k, v, causal=True, scale=scale)

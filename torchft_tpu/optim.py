@@ -1,0 +1,64 @@
+"""Fault-tolerant optimizer wrapper over optax.
+
+The reference wraps torch optimizers (ref /root/reference/torchft/optim.py:
+24-63): ``zero_grad()`` starts the quorum, ``step()`` only applies when the
+group votes to commit. JAX optimizers (optax) are pure transformations, so
+the TPU-native wrapper owns the (params, opt_state) pair functionally:
+
+    opt = OptimizerWrapper(manager, optax.adamw(3e-4))
+    opt_state = opt.init(params)
+    for batch in data:
+        opt.begin_step()                      # zero_grad analog: quorum
+        grads = grad_fn(params, batch)        # user's jitted compute
+        avg = ddp.average_gradients(grads)    # cross-replica DCN reduce
+        params, opt_state, committed = opt.step(params, opt_state, avg)
+
+The optax update itself is jitted once (static tree structure) — the commit
+decision happens OUTSIDE the compiled function, so quorum changes never
+recompile anything.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+__all__ = ["OptimizerWrapper"]
+
+
+class OptimizerWrapper:
+    """Gates optax updates on the manager's two-phase commit
+    (ref optim.py:24-63)."""
+
+    def __init__(self, manager, tx) -> None:
+        import jax
+        import optax
+
+        self.manager = manager
+        self.tx = tx
+
+        def _update(grads, opt_state, params):
+            updates, new_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_state
+
+        self._update = jax.jit(_update)
+
+    def init(self, params) -> Any:
+        return self.tx.init(params)
+
+    def begin_step(self, **kwargs) -> None:
+        """Start the (async) quorum — call before the forward pass
+        (the reference binds this to zero_grad, ref optim.py:49-51)."""
+        self.manager.start_quorum(**kwargs)
+
+    # Alias for API familiarity with the reference.
+    zero_grad = begin_step
+
+    def step(
+        self, params: Any, opt_state: Any, grads: Any
+    ) -> Tuple[Any, Any, bool]:
+        """Apply the update iff the replica group commits this step
+        (ref optim.py:53-55). Returns (params, opt_state, committed)."""
+        if self.manager.should_commit():
+            params, opt_state = self._update(grads, opt_state, params)
+            return params, opt_state, True
+        return params, opt_state, False

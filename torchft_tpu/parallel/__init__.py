@@ -1,0 +1,20 @@
+from torchft_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    FTMesh,
+    ft_mesh,
+)
+from torchft_tpu.parallel.ring import (  # noqa: F401
+    make_ring_attention,
+    ring_attention,
+)
+from torchft_tpu.parallel.sharding import (  # noqa: F401
+    fsdp_sharding,
+    make_sharding_fn,
+    replicated,
+    shard_pytree,
+    tp_rules_gpt,
+)

@@ -1,0 +1,109 @@
+"""Fault-tolerant device mesh for TPU slices.
+
+The reference virtualizes the replicate dimension of a torch DeviceMesh so
+HSDP's outer (DDP) dim is quorum-driven while the inner (FSDP) dim is a real
+process group (ref /root/reference/torchft/process_group.py:1057-1331,
+``ManagedDeviceMesh`` + ``ft_init_device_mesh``).
+
+TPU-native rendering: the in-group mesh is a real ``jax.sharding.Mesh`` over
+the slice's chips (ICI), with whatever axes the model needs — data / fsdp /
+tensor / seq(context) / expert. The REPLICA dimension never appears in the
+mesh or in any compiled program: replica count changes per quorum, and
+baking it into the HLO would force a recompile on every membership change
+(SURVEY.md §7 hard-part #1). Instead, `FTMesh` pairs the static in-group
+mesh with the Manager, whose ``num_participants()`` is the runtime size of
+the virtual replica axis.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = ["ft_mesh", "FTMesh", "AXIS_DATA", "AXIS_FSDP", "AXIS_TENSOR",
+           "AXIS_SEQ", "AXIS_EXPERT"]
+
+AXIS_DATA = "data"      # in-group data parallel (batch)
+AXIS_FSDP = "fsdp"      # in-group parameter sharding
+AXIS_TENSOR = "tensor"  # tensor parallel (per-layer sharding)
+AXIS_SEQ = "seq"        # sequence / context parallel (ring attention)
+AXIS_EXPERT = "expert"  # expert parallel (MoE)
+
+
+def ft_mesh(
+    axes: Dict[str, int],
+    devices: Optional[Sequence] = None,
+) -> "jax.sharding.Mesh":
+    """Build the in-group mesh over this replica group's chips.
+
+    ``axes`` maps axis name -> size, e.g. ``{"data": 2, "fsdp": 4}`` on an
+    8-chip slice. Sizes must multiply to the device count (use -1 for one
+    axis to infer it). The replica axis is deliberately NOT an argument —
+    see module docstring (analog of ft_init_device_mesh building the torch
+    mesh WITHOUT the replicate dim, ref process_group.py:1300-1331).
+    """
+    import jax
+    import numpy as np
+
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    known = 1
+    for s in sizes:
+        if s != -1:
+            known *= s
+    if -1 in sizes:
+        if len(devices) % known != 0:
+            raise ValueError(
+                f"cannot infer axis: {len(devices)} devices not divisible "
+                f"by {known}"
+            )
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} need {total} devices, "
+            f"have {len(devices)}"
+        )
+    device_array = np.array(devices).reshape(sizes)
+    return jax.sharding.Mesh(device_array, tuple(names))
+
+
+class FTMesh:
+    """Static in-group mesh + dynamic (quorum-driven) replica dimension
+    (the ManagedDeviceMesh analog, ref process_group.py:1086-1261)."""
+
+    def __init__(self, manager, mesh) -> None:
+        self.manager = manager
+        self.mesh = mesh
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    def axis_size(self, name: str) -> int:
+        return self.mesh.shape[name]
+
+    def num_replicas(self) -> int:
+        """Size of the virtual replica axis = current quorum participants.
+        Reported as >= 1 even with zero participants, matching ref
+        process_group.py:1187-1202."""
+        return max(1, self.manager.num_participants())
+
+    def global_batch_ratio(self) -> float:
+        """Multiplier for metrics: how many replica-group batches commit
+        per step right now."""
+        return float(self.num_replicas())
+
+    def sharding(self, *pspec) -> "jax.sharding.NamedSharding":
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec(*pspec))
+
+    def __repr__(self) -> str:
+        return (
+            f"FTMesh(in_group={dict(self.mesh.shape)}, "
+            f"replicas~{self.num_replicas()})"
+        )

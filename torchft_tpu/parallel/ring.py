@@ -1,0 +1,143 @@
+"""Ring attention: sequence/context parallelism over the ICI mesh.
+
+The reference has NO long-context machinery (SURVEY.md §2c: SP/CP absent) —
+this is a first-class TPU-native addition per the framework goals. Sequence
+length is sharded over a mesh axis; each device holds a Q/K/V block and
+K/V blocks rotate around the ring via ``lax.ppermute`` while a streaming
+(online-softmax) accumulator builds exact attention — compute on block t
+overlaps the transfer of block t+1 on ICI, so attention over N×seq context
+costs N ring steps of local flash-style work (Ring Attention,
+https://arxiv.org/abs/2310.01889; blockwise parallel transformers).
+
+Everything is ordinary jax inside ``shard_map`` — no host transfers, static
+shapes, `lax.fori_loop` control flow — so XLA pipelines the ppermute with
+the MXU matmuls. A pallas flash kernel can replace the local block math
+(ops/attention.py) without touching the ring structure.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+__all__ = ["ring_attention", "make_ring_attention"]
+
+
+def _local_attention_step(q, k, v, o, m, l, q_offset, k_offset, scale,
+                          causal):
+    """One streaming-softmax accumulation of a (q-block, kv-block) pair.
+
+    q: [B, Sq, H, D], k/v: [B, Sk, H, D]
+    o: [B, Sq, H, D] accumulator (numerator), m/l: [B, H, Sq] running
+    max / denominator. Returns updated (o, m, l).
+    """
+    import jax.numpy as jnp
+
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B,H,Sq,Sk]
+
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]  # [Sq, Sk]
+        s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
+
+    m_block = jnp.max(s, axis=-1)                   # [B,H,Sq]
+    m_new = jnp.maximum(m, m_block)
+    # Guard fully-masked rows (m_new == -inf): exp(-inf - -inf) = nan.
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])              # [B,H,Sq,Sk]
+    p = jnp.where(jnp.isneginf(s), 0.0, p)
+    alpha = jnp.exp(jnp.where(jnp.isneginf(m), 0.0, m) - m_safe)
+    alpha = jnp.where(jnp.isneginf(m), 0.0, alpha)  # first block: no history
+    l_new = alpha * l + jnp.sum(p, axis=-1)
+    o_new = (
+        o * alpha.transpose(0, 2, 1)[..., None]
+        + jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    )
+    return o_new, m_new, l_new
+
+
+def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
+                            scale: Optional[float]):
+    """Per-device body under shard_map: q,k,v are LOCAL seq blocks
+    [B, S_local, H, D]."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    d = q.shape[-1]
+    eff_scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    o0 = jnp.zeros(q.shape, dtype=jnp.float32)
+    m0 = jnp.full((q.shape[0], q.shape[2], s_local), -jnp.inf,
+                  dtype=jnp.float32)
+    l0 = jnp.zeros((q.shape[0], q.shape[2], s_local), dtype=jnp.float32)
+
+    qf = q.astype(jnp.float32)
+
+    def body(t, carry):
+        o, m, l, k_t, v_t = carry
+        src_block = (idx - t) % n  # whose kv block we hold at ring step t
+        o, m, l = _local_attention_step(
+            qf, k_t.astype(jnp.float32), v_t.astype(jnp.float32),
+            o, m, l,
+            q_offset=idx * s_local,
+            k_offset=src_block * s_local,
+            scale=eff_scale,
+            causal=causal,
+        )
+        # Rotate kv one step around the ring (device i -> i+1), overlapping
+        # with the next iteration's compute under XLA's scheduler.
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_n = lax.ppermute(k_t, axis_name, perm)
+        v_n = lax.ppermute(v_t, axis_name, perm)
+        return o, m, l, k_n, v_n
+
+    o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(mesh, axis_name: str = "seq", causal: bool = True,
+                        scale: Optional[float] = None):
+    """Build a jittable attention fn over sequence-sharded q,k,v.
+
+    Inputs/outputs are GLOBAL arrays [B, S, H, D] sharded on S over
+    ``axis_name`` (use `jax.device_put` with PartitionSpec(None, axis_name,
+    None, None)). Wraps the per-device ring in shard_map.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map  # jax >= 0.7
+
+        check_kwargs = {"check_vma": False}
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+
+        check_kwargs = {"check_rep": False}
+
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(
+        _ring_attention_sharded,
+        axis_name=axis_name,
+        causal=causal,
+        scale=scale,
+    )
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        **check_kwargs,
+    )
+
+
+def ring_attention(q, k, v, mesh, axis_name: str = "seq",
+                   causal: bool = True, scale: Optional[float] = None):
+    """One-shot convenience wrapper around make_ring_attention."""
+    return make_ring_attention(mesh, axis_name, causal, scale)(q, k, v)

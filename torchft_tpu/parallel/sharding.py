@@ -1,0 +1,167 @@
+"""Parameter sharding rules: FSDP / tensor-parallel NamedShardings.
+
+The reference delegates intra-group sharding to torch FSDP2 via
+``fully_shard`` over the managed mesh (ref fsdp_test.py:40-74); only the
+replica dim is torchft's. This framework is self-contained on TPU, so the
+in-group dimension is first-class here (SURVEY.md §2c implication):
+
+- **FSDP**: every parameter is sharded on its largest divisible axis over
+  the ``fsdp`` mesh axis; XLA inserts the all-gathers at use sites and
+  reduce-scatters in the backward pass (the "Automatic Cross-Replica
+  Sharding of Weight Update" recipe — ZeRO-3 by sharding annotation).
+- **TP**: regex rules over parameter path names place matmul weights
+  column- or row-parallel on the ``tensor`` axis (Megatron layout:
+  qkv/up-projections column-split, out/down-projections row-split), which
+  XLA turns into psum/all-gather collectives over ICI.
+
+Everything here produces `NamedSharding`s to feed `jax.device_put` /
+`jit(..., in_shardings=...)` — no manual collectives.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "fsdp_sharding",
+    "tp_rules_gpt",
+    "make_sharding_fn",
+    "shard_pytree",
+    "replicated",
+]
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def _largest_divisible_dim(shape: Sequence[int], size: int,
+                           exclude: Sequence[int] = ()) -> Optional[int]:
+    best = None
+    for i, d in enumerate(shape):
+        if i in exclude:
+            continue
+        if d % size == 0 and (best is None or d > shape[best]):
+            best = i
+    return best
+
+
+def fsdp_sharding(mesh, shape: Sequence[int], dtype=None,
+                  axis: str = "fsdp",
+                  pspec_so_far: Optional[List[Optional[str]]] = None):
+    """NamedSharding sharding `shape`'s largest divisible dim over `axis`.
+
+    Params too small to shard (no divisible dim, or 0-d) stay replicated —
+    same policy torch FSDP applies to tiny tensors.
+    ``pspec_so_far`` lets TP-sharded dims be respected (HSDP-style
+    composition: fsdp shards a dim TP didn't take)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if axis not in mesh.shape:
+        return replicated(mesh)
+    size = mesh.shape[axis]
+    spec: List[Optional[Any]] = (
+        list(pspec_so_far) if pspec_so_far is not None
+        else [None] * len(shape)
+    )
+    taken = [i for i, s in enumerate(spec) if s is not None]
+    dim = _largest_divisible_dim(shape, size, exclude=taken)
+    if dim is None or len(shape) == 0:
+        return NamedSharding(mesh, PartitionSpec(*spec))
+    spec[dim] = axis
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+# --- Tensor parallel rules ---------------------------------------------------
+
+# Each rule: (path regex, dim to shard on the tensor axis) where dim indexes
+# the weight's shape; None dim = replicate.
+TpRule = Tuple[str, Optional[int]]
+
+
+def tp_rules_gpt() -> List[TpRule]:
+    """Megatron-style layout for the models/transformer.py GPT family:
+    column-parallel for QKV and MLP-up (output dim), row-parallel for
+    attn-out and MLP-down (input dim); embeddings sharded on vocab."""
+    return [
+        (r".*attn.*(q_proj|k_proj|v_proj|qkv).*kernel", 1),   # column
+        (r".*attn.*(o_proj|out_proj).*kernel", 0),            # row
+        (r".*mlp.*(up_proj|gate_proj|fc1).*kernel", 1),       # column
+        (r".*mlp.*(down_proj|fc2).*kernel", 0),               # row
+        (r".*wpe.*", None),             # positional table: replicate
+        (r".*(wte|tok_embed).*", 0),    # token embeddings: vocab shard
+        (r".*lm_head.*kernel", 1),                            # vocab out
+        (r".*bias", None),
+        (r".*(ln|layernorm|norm|scale).*", None),
+    ]
+
+
+def _path_str(path) -> str:
+    import jax
+
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        elif isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(p.name)
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def make_sharding_fn(
+    mesh,
+    tp_rules: Optional[List[TpRule]] = None,
+    fsdp_axis: Optional[str] = "fsdp",
+    tensor_axis: str = "tensor",
+) -> Callable:
+    """Returns fn(path, leaf) -> NamedSharding combining TP rules with FSDP
+    sharding of the remaining dims (the HSDP in-group composition)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    have_tp = tensor_axis in mesh.shape and mesh.shape[tensor_axis] > 1
+    have_fsdp = fsdp_axis is not None and fsdp_axis in mesh.shape and (
+        mesh.shape[fsdp_axis] > 1
+    )
+
+    def _fn(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        spec: List[Optional[str]] = [None] * len(shape)
+        if have_tp and tp_rules:
+            name = _path_str(path)
+            for pattern, dim in tp_rules:
+                if re.fullmatch(pattern, name):
+                    if (
+                        dim is not None
+                        and dim < len(shape)
+                        and shape[dim] % mesh.shape[tensor_axis] == 0
+                    ):
+                        spec[dim] = tensor_axis
+                    break
+        if have_fsdp:
+            return fsdp_sharding(
+                mesh, shape, axis=fsdp_axis, pspec_so_far=spec
+            )
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
+    return _fn
+
+
+def shard_pytree(params: Any, mesh, tp_rules: Optional[List[TpRule]] = None,
+                 fsdp_axis: Optional[str] = "fsdp",
+                 tensor_axis: str = "tensor") -> Any:
+    """device_put every leaf with its computed NamedSharding."""
+    import jax
+
+    fn = make_sharding_fn(mesh, tp_rules, fsdp_axis, tensor_axis)
+
+    def _place(path, leaf):
+        return jax.device_put(leaf, fn(path, leaf))
+
+    return jax.tree_util.tree_map_with_path(_place, params)
