@@ -1,0 +1,165 @@
+"""Fault-tolerant DDP training example (the reference train_ddp.py analog,
+/root/reference/train_ddp.py:33-156 — CIFAR CNN there; a synthetic-data
+transformer here since this image has no dataset downloads).
+
+Run one replica group (repeat per group, or use torchft_tpu.launcher):
+
+    python -m torchft_tpu.lighthouse_cli --min_replicas 1 &
+    REPLICA_GROUP_ID=0 NUM_REPLICA_GROUPS=2 \
+    TORCHFT_TPU_LIGHTHOUSE=http://host:29510 \
+        python examples/train_ddp.py
+
+Kill any replica group at any time: survivors keep committing; the
+relaunched group heals from a live checkpoint and rejoins — the loop below
+needs zero failure-handling code for that.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import sys
+
+logging.basicConfig(
+    level=os.environ.get("LOGLEVEL", "WARNING"),
+    format="%(asctime)s %(name)s: %(message)s",
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from torchft_tpu import (
+    DistributedDataParallel,
+    DistributedSampler,
+    Manager,
+    OptimizerWrapper,
+    TcpCommContext,
+)
+from torchft_tpu.comm.store import StoreServer
+from torchft_tpu.models import CONFIGS, init_params, make_grad_step
+
+
+def main() -> None:
+    replica_group = int(os.environ.get("REPLICA_GROUP_ID", "0"))
+    num_groups = int(os.environ.get("NUM_REPLICA_GROUPS", "2"))
+    total_steps = int(os.environ.get("TOTAL_STEPS", "50"))
+    ckpt_path = os.environ.get(
+        "CKPT_PATH", f"/tmp/torchft_tpu_ddp_{replica_group}.ckpt"
+    )
+
+    cfg = CONFIGS[os.environ.get("MODEL", "tiny")]
+    tx = optax.adamw(3e-4)
+
+    params = init_params(cfg, jax.random.key(0))
+    state = {"params": params, "opt": tx.init(params)}
+
+    # synthetic next-token dataset, sharded across groups x ranks
+    rng = np.random.default_rng(0)
+    dataset = rng.integers(0, cfg.vocab_size, (4096, cfg.max_seq_len))
+    sampler = DistributedSampler(
+        len(dataset),
+        replica_group=replica_group,
+        num_replica_groups=num_groups,
+        shuffle=True,
+        seed=1,
+    )
+
+    def load_state_dict(sd):
+        state.update(sd["train"])
+        sampler.load_state_dict(sd["sampler"])
+
+    def state_dict():
+        return {"train": dict(state), "sampler": sampler.state_dict()}
+
+    # Per-group rendezvous store: rank 0 binds it (the group-master
+    # TCPStore role); other local ranks connect via MASTER_ADDR/PORT.
+    rank = int(os.environ.get("RANK", "0"))
+    world_size = int(os.environ.get("WORLD_SIZE", "1"))
+    store = None
+    if rank == 0:
+        store = StoreServer(
+            host="0.0.0.0",
+            port=int(os.environ.get("MASTER_PORT", "0")),
+        )
+        store_addr = store.addr
+    else:
+        store_addr = (
+            f"{os.environ['MASTER_ADDR']}:{os.environ['MASTER_PORT']}"
+        )
+    manager = Manager(
+        comm=TcpCommContext(),
+        load_state_dict=load_state_dict,
+        state_dict=state_dict,
+        min_replica_size=1,
+        rank=rank,
+        world_size=world_size,
+        store_addr=store_addr,
+        replica_id=f"train_ddp_{replica_group}_",
+    )
+    ddp = DistributedDataParallel(manager)
+    opt = OptimizerWrapper(manager, tx)
+    grad_step = make_grad_step(cfg)
+
+    # Durable-checkpoint resume is the user's job (ref train_ddp.py:141-148)
+    # — the manager state_dict MUST be part of it.
+    if os.path.exists(ckpt_path):
+        with open(ckpt_path, "rb") as f:
+            saved = pickle.load(f)
+        load_state_dict(saved["user"])
+        manager.load_state_dict(saved["manager"])
+        print(f"resumed from {ckpt_path} at step {manager.current_step()}")
+
+    batch_size = 8
+    it = iter(sampler)
+
+    def next_batch():
+        nonlocal it
+        idx = []
+        while len(idx) < batch_size:
+            try:
+                idx.append(next(it))
+            except StopIteration:
+                sampler.set_epoch(sampler.epoch + 1)
+                it = iter(sampler)
+        tokens = jnp.asarray(dataset[idx], dtype=jnp.int32)
+        return tokens, jnp.roll(tokens, -1, axis=1)
+
+    while manager.current_step() < total_steps:
+        tokens, targets = next_batch()
+        opt.begin_step()
+        loss, grads = grad_step(state["params"], tokens, targets)
+        avg = ddp.average_gradients(grads)
+        new_params, new_opt, committed = opt.step(
+            state["params"], state["opt"], avg
+        )
+        if committed:
+            state["params"], state["opt"] = new_params, new_opt
+            step = manager.current_step()
+            print(
+                f"[group {replica_group}] step {step} "
+                f"loss {float(loss):.4f} "
+                f"participants {manager.num_participants()}"
+            )
+            if step % 10 == 0:
+                with open(ckpt_path, "wb") as f:
+                    pickle.dump(
+                        {
+                            "user": state_dict(),
+                            "manager": manager.state_dict(),
+                        },
+                        f,
+                    )
+
+    manager.shutdown()
+    if store is not None:
+        store.shutdown()
+    print(f"[group {replica_group}] done at step {manager.current_step()}")
+
+
+if __name__ == "__main__":
+    main()

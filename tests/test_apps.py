@@ -1,0 +1,108 @@
+"""Tests for apps/ops: parameter server, launcher specs, lighthouse CLI."""
+
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchft_tpu.launcher import LIGHTHOUSE_ENV, hsdp_spec, launch_local
+from torchft_tpu.parameter_server import (
+    ParameterServer,
+    ParameterServerClient,
+)
+
+
+class EchoPS(ParameterServer):
+    """Server doubles whatever the client broadcasts to it."""
+
+    def __init__(self):
+        super().__init__(timeout=10.0)
+        self.sessions = []
+
+    def handle_session(self, session_id, comm):
+        self.sessions.append(session_id)
+        # receive from client (client is broadcast root)
+        received = comm.broadcast(
+            [np.zeros(4, np.float32)], root=1
+        ).future().result(timeout=10)
+        doubled = [a * 2 for a in received]
+        comm.broadcast(doubled, root=0).future().result(timeout=10)
+
+
+def test_parameter_server_session_roundtrip() -> None:
+    ps = EchoPS()
+    try:
+        client = ParameterServerClient(ps.address(), timeout=10.0)
+        comm = client.new_session()
+        payload = np.full(4, 21.0, dtype=np.float32)
+        comm.broadcast([payload], root=1).future().result(timeout=10)
+        out = comm.broadcast(
+            [np.zeros(4, np.float32)], root=0
+        ).future().result(timeout=10)
+        np.testing.assert_allclose(out[0], np.full(4, 42.0))
+        assert len(ps.sessions) == 1
+        comm.shutdown()
+
+        # second session gets a fresh context
+        comm2 = client.new_session()
+        comm2.broadcast([payload], root=1).future().result(timeout=10)
+        comm2.broadcast(
+            [np.zeros(4, np.float32)], root=0
+        ).future().result(timeout=10)
+        assert len(ps.sessions) == 2
+        comm2.shutdown()
+    finally:
+        ps.shutdown()
+
+
+def test_hsdp_spec_env_plumbing() -> None:
+    specs = hsdp_spec(
+        script="examples/train_ddp.py",
+        num_replica_groups=3,
+        lighthouse_addr="http://lh:29510",
+        workers_per_group=4,
+        extra_env={"MODEL": "tiny"},
+        script_args=["--flag"],
+    )
+    assert len(specs) == 12  # groups x workers
+    for spec in specs:
+        i, r = spec.replica_group_id, spec.rank
+        assert spec.env[LIGHTHOUSE_ENV] == "http://lh:29510"
+        assert spec.env["REPLICA_GROUP_ID"] == str(i)
+        assert spec.env["NUM_REPLICA_GROUPS"] == "3"
+        assert spec.env["RANK"] == str(r)
+        assert spec.env["WORLD_SIZE"] == "4"
+        assert spec.env["MASTER_PORT"] == str(29700 + i)
+        assert spec.env["TORCHFT_TPU_MANAGER_PORT"] == str(29600 + i)
+        assert spec.env["MODEL"] == "tiny"
+        assert spec.cmd[-1] == "--flag"
+    assert {(s.replica_group_id, s.rank) for s in specs} == {
+        (i, r) for i in range(3) for r in range(4)
+    }
+
+
+def test_lighthouse_cli_starts_and_serves() -> None:
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "torchft_tpu.lighthouse_cli",
+            "--min_replicas", "1", "--bind", "127.0.0.1:0",
+            "--hostname", "127.0.0.1",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert "lighthouse serving at" in line, line
+        addr = line.strip().rsplit(" ", 1)[-1]
+        import urllib.request
+
+        html = urllib.request.urlopen(addr + "/", timeout=5).read().decode()
+        assert "lighthouse" in html
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

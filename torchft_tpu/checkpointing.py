@@ -146,12 +146,11 @@ class CheckpointServer(CheckpointTransport[T]):
         )
         self._thread.start()
 
-        host = socket.gethostname()
-        try:
-            socket.getaddrinfo(host, None)
-        except OSError:
-            host = "127.0.0.1"
-        self._addr = f"http://{host}:{self._server.server_address[1]}"
+        from torchft_tpu.utils.net import advertised_host
+
+        self._addr = (
+            f"http://{advertised_host()}:{self._server.server_address[1]}"
+        )
 
     # -- CheckpointTransport ------------------------------------------------
 

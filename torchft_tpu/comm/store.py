@@ -55,13 +55,18 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 class StoreServer:
     """In-process KV store server. Bind with port=0 for an ephemeral port."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 advertise_host: Optional[str] = None) -> None:
+        """``host`` is the bind address; ``advertise_host`` is what
+        ``addr`` reports to peers (pass "0.0.0.0" + an advertised host for
+        cross-host rendezvous)."""
         self._data: Dict[str, bytes] = {}
         self._cond = threading.Condition()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(512)
+        self._advertise_host = advertise_host
         self._shutdown = False
         self._thread = threading.Thread(
             target=self._accept_loop, name="torchft_tpu_store", daemon=True
@@ -71,6 +76,8 @@ class StoreServer:
     @property
     def addr(self) -> str:
         host, port = self._sock.getsockname()[:2]
+        if self._advertise_host:
+            host = self._advertise_host
         return f"{host}:{port}"
 
     @property

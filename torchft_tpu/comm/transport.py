@@ -147,12 +147,12 @@ class TcpCommContext(CommContext):
             listener.listen(world_size)
             listener.settimeout(self._timeout)
             self._listener = listener
-            host = socket.gethostname()
-            try:
-                socket.getaddrinfo(host, None)
-            except OSError:
-                host = "127.0.0.1"
-            store.set("comm_addr", f"{host}:{listener.getsockname()[1]}")
+            from torchft_tpu.utils.net import advertised_host
+
+            store.set(
+                "comm_addr",
+                f"{advertised_host()}:{listener.getsockname()[1]}",
+            )
             peers: Dict[int, socket.socket] = {}
             try:
                 while len(peers) < world_size - 1:

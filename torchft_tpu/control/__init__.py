@@ -145,15 +145,10 @@ class ManagerServer:
     ) -> None:
         if hostname is None:
             # The advertised address crosses hosts (it becomes peers'
-            # recover_src_manager_address), so default to the machine
-            # hostname, not loopback — unless it doesn't resolve locally.
-            import socket as _socket
+            # recover_src_manager_address).
+            from torchft_tpu.utils.net import advertised_host
 
-            hostname = _socket.gethostname()
-            try:
-                _socket.getaddrinfo(hostname, None)
-            except OSError:
-                hostname = "127.0.0.1"
+            hostname = advertised_host()
         host, port = _split_bind(bind)
         lib = get_lib()
         err = ctypes.c_char_p()

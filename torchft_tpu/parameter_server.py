@@ -1,0 +1,137 @@
+"""Lighthouse-free parameter-server topology prototype.
+
+Reference: /root/reference/torchft/parameter_server.py:31-195 — an HTTP
+endpoint mints a session (uuid + store prefix); the server side then
+configures a fresh 2-rank comm context (rank 0) and runs a user-defined
+handler against it, while the client configures rank 1 of the same
+session. Built here on the framework's own StoreServer + TcpCommContext
+instead of torch TCPStore + c10d.
+
+Usage:
+
+    class MyPS(ParameterServer):
+        def handle_session(self, session_id, comm):
+            weights = comm.broadcast([w], root=0).future().result()
+            ...
+
+    ps = MyPS()
+    # client process:
+    comm = ParameterServerClient(ps.address()).new_session()
+    comm.broadcast([...], root=0)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.request
+import uuid
+from abc import ABC, abstractmethod
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from torchft_tpu.comm.context import CommContext
+from torchft_tpu.comm.store import StoreServer
+from torchft_tpu.comm.transport import TcpCommContext
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ParameterServer", "ParameterServerClient"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, format, *args):  # noqa: A002
+        logger.debug("ps http: " + format, *args)
+
+    def do_GET(self) -> None:  # noqa: N802
+        ps: "ParameterServer" = self.server.ps  # type: ignore[attr-defined]
+        if self.path != "/new_session":
+            self.send_error(404)
+            return
+        session_id = str(uuid.uuid4())
+        body = json.dumps(
+            {
+                "session_id": session_id,
+                "store_addr": f"{ps._store.addr}/ps/{session_id}",
+                "world_size": 2,
+            }
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        # Response is complete; now hijack this handler thread to serve the
+        # session as rank 0 (the reference does exactly this,
+        # ref parameter_server.py:121-160).
+        try:
+            comm = ps._make_comm()
+            comm.configure(f"{ps._store.addr}/ps/{session_id}", 0, 2)
+            try:
+                ps.handle_session(session_id, comm)
+            finally:
+                comm.shutdown()
+        except Exception:
+            logger.exception("parameter server session %s failed", session_id)
+        self.close_connection = True
+
+
+class ParameterServer(ABC):
+    """Serve per-session comm contexts to clients (ref parameter_server.py:31-96)."""
+
+    def __init__(self, port: int = 0, timeout: float = 60.0) -> None:
+        from torchft_tpu.utils.net import advertised_host
+
+        self._timeout = timeout
+        # Bind all interfaces and advertise a routable host so sessions
+        # work cross-host (clients dial the store for comm rendezvous).
+        self._store = StoreServer(
+            host="0.0.0.0", advertise_host=advertised_host()
+        )
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+        self._server.daemon_threads = True
+        self._server.ps = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="torchft_tpu_ps",
+        )
+        self._thread.start()
+
+    def _make_comm(self) -> CommContext:
+        return TcpCommContext(timeout=self._timeout)
+
+    def address(self) -> str:
+        from torchft_tpu.utils.net import advertised_host
+
+        return (
+            f"http://{advertised_host()}:{self._server.server_address[1]}"
+        )
+
+    @abstractmethod
+    def handle_session(self, session_id: str, comm: CommContext) -> None:
+        """Run the server side of one session (rank 0 of world 2)."""
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._store.shutdown()
+
+
+class ParameterServerClient:
+    """Client: mint a session and get the rank-1 comm context
+    (ref parameter_server.py:162-195)."""
+
+    def __init__(self, addr: str, timeout: float = 60.0) -> None:
+        self._addr = addr
+        self._timeout = timeout
+
+    def new_session(self) -> CommContext:
+        with urllib.request.urlopen(
+            f"{self._addr}/new_session", timeout=self._timeout
+        ) as resp:
+            info = json.loads(resp.read())
+        comm = TcpCommContext(timeout=self._timeout)
+        comm.configure(info["store_addr"], 1, info["world_size"])
+        return comm
