@@ -54,17 +54,21 @@ def main() -> None:
 
     cfg = CONFIGS[os.environ.get("MODEL", "tiny")]
     tx = optax.adamw(3e-4)
+    rank = int(os.environ.get("RANK", "0"))
+    world_size = int(os.environ.get("WORLD_SIZE", "1"))
 
     params = init_params(cfg, jax.random.key(0))
     state = {"params": params, "opt": tx.init(params)}
 
-    # synthetic next-token dataset, sharded across groups x ranks
+    # synthetic next-token dataset, sharded across groups x local ranks
     rng = np.random.default_rng(0)
     dataset = rng.integers(0, cfg.vocab_size, (4096, cfg.max_seq_len))
     sampler = DistributedSampler(
         len(dataset),
         replica_group=replica_group,
         num_replica_groups=num_groups,
+        rank=rank,
+        num_replicas=world_size,
         shuffle=True,
         seed=1,
     )
@@ -78,8 +82,6 @@ def main() -> None:
 
     # Per-group rendezvous store: rank 0 binds it (the group-master
     # TCPStore role); other local ranks connect via MASTER_ADDR/PORT.
-    rank = int(os.environ.get("RANK", "0"))
-    world_size = int(os.environ.get("WORLD_SIZE", "1"))
     store = None
     if rank == 0:
         store = StoreServer(

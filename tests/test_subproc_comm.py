@@ -1,0 +1,96 @@
+"""Subprocess-isolated comm tests (spec: ref process_group_test.py
+baby-PG lifecycle :216-267)."""
+
+import os
+import signal
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.comm.store import StoreServer
+from torchft_tpu.comm.subproc import SubprocessCommContext
+
+
+@pytest.fixture()
+def store():
+    server = StoreServer()
+    yield server
+    server.shutdown()
+
+
+def test_subproc_allreduce_two_ranks(store) -> None:
+    ctxs = [SubprocessCommContext(timeout=20.0) for _ in range(2)]
+    try:
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            futs = [
+                pool.submit(ctxs[r].configure, f"{store.addr}/sp", r, 2)
+                for r in range(2)
+            ]
+            for f in futs:
+                f.result(timeout=30)
+        w0 = ctxs[0].allreduce([np.full(4, 1.0, np.float32)])
+        w1 = ctxs[1].allreduce([np.full(4, 2.0, np.float32)])
+        np.testing.assert_allclose(
+            w0.future().result(timeout=20)[0], np.full(4, 3.0)
+        )
+        w1.future().result(timeout=20)
+        # child really is a separate process
+        assert ctxs[0].child_pid() not in (None, os.getpid())
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+def test_subproc_reconfigure_kills_child(store) -> None:
+    ctx = SubprocessCommContext(timeout=10.0)
+    try:
+        ctx.configure(f"{store.addr}/solo1", 0, 1)
+        pid1 = ctx.child_pid()
+        out = ctx.allreduce([np.ones(2)]).future().result(timeout=10)
+        np.testing.assert_allclose(out[0], np.ones(2))
+
+        ctx.configure(f"{store.addr}/solo2", 0, 1)
+        pid2 = ctx.child_pid()
+        assert pid1 != pid2  # previous child was killed
+        out = ctx.allreduce([np.full(2, 5.0)]).future().result(timeout=10)
+        np.testing.assert_allclose(out[0], np.full(2, 5.0))
+    finally:
+        ctx.shutdown()
+
+
+def test_subproc_wedged_child_killed(store) -> None:
+    # Simulate a wedged transport: SIGSTOP the child mid-life; an op then
+    # fails (or hangs) but configure() recovers by SIGKILLing it — the
+    # trainer process survives. This is the exact scenario the baby-PG
+    # design exists for (SURVEY.md §7 hard-part #2).
+    ctx = SubprocessCommContext(timeout=2.0)
+    try:
+        ctx.configure(f"{store.addr}/wedge", 0, 1)
+        pid = ctx.child_pid()
+        os.kill(pid, signal.SIGSTOP)  # child frozen: ops cannot complete
+        work = ctx.allreduce([np.ones(2)])
+        with pytest.raises((ConnectionError, TimeoutError, Exception)):
+            work.future().result(timeout=15)
+        # recover
+        ctx.configure(f"{store.addr}/wedge2", 0, 1)
+        assert ctx.child_pid() != pid
+        out = ctx.allreduce([np.full(3, 2.0)]).future().result(timeout=10)
+        np.testing.assert_allclose(out[0], np.full(3, 2.0))
+    finally:
+        ctx.shutdown()
+
+
+def test_subproc_child_death_surfaces_error(store) -> None:
+    ctx = SubprocessCommContext(timeout=5.0)
+    try:
+        ctx.configure(f"{store.addr}/die", 0, 1)
+        os.kill(ctx.child_pid(), signal.SIGKILL)
+        time.sleep(0.3)
+        work = ctx.allreduce([np.ones(2)])
+        with pytest.raises(Exception):
+            work.future().result(timeout=15)
+        assert ctx.errored() is not None
+    finally:
+        ctx.shutdown()

@@ -24,6 +24,7 @@ from torchft_tpu.comm.context import (  # noqa: F401
     ManagedCommContext,
     ReduceOp,
 )
+from torchft_tpu.comm.subproc import SubprocessCommContext  # noqa: F401
 from torchft_tpu.comm.transport import TcpCommContext  # noqa: F401
 from torchft_tpu.data import DistributedSampler  # noqa: F401
 from torchft_tpu.ddp import (  # noqa: F401
@@ -56,6 +57,7 @@ __all__ = [
     "OptimizerWrapper",
     "PureDistributedDataParallel",
     "ReduceOp",
+    "SubprocessCommContext",
     "TcpCommContext",
     "WorldSizeMode",
     "future_chain",

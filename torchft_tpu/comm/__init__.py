@@ -15,3 +15,4 @@ from torchft_tpu.comm.context import (  # noqa: F401
     Work,
 )
 from torchft_tpu.comm.transport import TcpCommContext  # noqa: F401
+from torchft_tpu.comm.subproc import SubprocessCommContext  # noqa: F401

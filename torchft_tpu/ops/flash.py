@@ -1,0 +1,267 @@
+"""Pallas flash-attention (forward) kernel for TPU.
+
+Streams K/V blocks through VMEM with an online-softmax accumulator so the
+[S, S] score matrix never materializes in HBM; per q-block the causal loop
+runs only over the k-blocks at or before the diagonal, so causal attention
+does half the FLOPs of the dense path. Scores/accumulation in f32 on the
+MXU (preferred_element_type), inputs/outputs bf16.
+
+Backward: a custom_vjp whose backward pass recomputes attention with the
+XLA reference path — gradients are exact; the flash memory win applies to
+the forward (and the backward lives under the model's per-layer remat,
+models/transformer.py). A fused pallas backward is a later optimization.
+
+Use interpret=True (or TORCHFT_TPU_PALLAS_INTERPRET=1) to run the same
+kernel on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific bits are unavailable when lowering for CPU interpret
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30  # avoid nan from (-inf) - (-inf) in the running max
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+                  seq_len: int, causal: bool, scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
+    d = q.shape[-1]
+
+    num_k_blocks = seq_len // block_k
+    if causal:
+        # blocks strictly after the diagonal contribute nothing
+        last_block = ((qi + 1) * block_q + block_k - 1) // block_k
+        upper = jnp.minimum(num_k_blocks, last_block)
+    else:
+        upper = num_k_blocks
+
+    acc0 = jnp.zeros((block_q, d), dtype=jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((block_q,), dtype=jnp.float32)
+
+    def body(ki, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_streamed_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                           l_ref, *, block_q: int, block_k: int,
+                           num_k_blocks: int, causal: bool, scale: float):
+    """K-blocks ride the innermost grid dimension: only (block_k, d) K/V
+    tiles are VMEM-resident at a time, so sequence length is bounded by
+    HBM, not VMEM. acc/m/l live in VMEM scratch across the k sweep."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal: k-blocks strictly above the diagonal contribute nothing.
+    relevant = (
+        ki * block_k < (qi + 1) * block_q if causal else ki >= 0
+    )
+
+    @pl.when(relevant)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale   # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)           # [BK, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                      # [BQ, 1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+# KV footprint above which the k-streamed kernel is used (resident variant
+# holds all of K+V in VMEM, which is faster for short/medium sequences).
+_RESIDENT_KV_BYTES = 2 * 1024 * 1024
+
+
+def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
+                   block_k: int, interpret: bool):
+    """q,k,v: [BH, S, D] -> [BH, S, D]."""
+    bh, seq_len, d = q.shape
+    kv_bytes = 2 * seq_len * d * q.dtype.itemsize
+    if kv_bytes <= _RESIDENT_KV_BYTES:
+        grid = (bh, seq_len // block_q)
+        kernel = functools.partial(
+            _flash_kernel,
+            block_q=block_q,
+            block_k=block_k,
+            seq_len=seq_len,
+            causal=causal,
+            scale=scale,
+        )
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=interpret,
+        )(q, k, v)
+
+    # Long context: stream K/V tiles via the grid.
+    num_k_blocks = seq_len // block_k
+    grid = (bh, seq_len // block_q, num_k_blocks)
+    kernel = functools.partial(
+        _flash_streamed_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        num_k_blocks=num_k_blocks,
+        causal=causal,
+        scale=scale,
+    )
+    scratch = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, 128), jnp.float32),
+        pltpu.VMEM((block_q, 128), jnp.float32),
+    ]
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _reference(q, k, v, causal: bool, scale: float):
+    """[BH,S,D] layout adapter over ops.attention.reference_attention."""
+    from torchft_tpu.ops.attention import reference_attention
+
+    out = reference_attention(
+        q[:, :, None], k[:, :, None], v[:, :, None], causal=causal,
+        scale=scale,
+    )
+    return out[:, :, 0].astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    # Exact gradients by differentiating the reference formulation.
+    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, causal, scale),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """[B, S, H, D] flash attention (pallas on TPU).
+
+    Sequence length must be a multiple of the block sizes (pad upstream if
+    needed; the model configs here use powers of two).
+    """
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = bool(os.environ.get("TORCHFT_TPU_PALLAS_INTERPRET"))
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(
+            f"seq len {s} must be a multiple of block sizes "
+            f"({block_q}, {block_k})"
+        )
+
+    def _merge(x):  # [B,S,H,D] -> [B*H, S, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out = _flash(_merge(q), _merge(k), _merge(v), causal, float(scale),
+                 block_q, block_k, interpret)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
