@@ -211,3 +211,87 @@ def test_large_buffer_allreduce(store) -> None:
     results = _run_ranks(store, 2, _fn, timeout=60.0)
     np.testing.assert_allclose(results[0][0][:10], np.full(10, 3.0))
     np.testing.assert_allclose(results[1][0][-10:], np.full(10, 3.0))
+
+
+# ------------------------------------------------------------- ring variant
+
+
+def _run_ring(store, world_size, fn, prefix="ring", timeout=30.0):
+    ctxs = [TcpCommContext(timeout=10.0, algorithm="ring")
+            for _ in range(world_size)]
+    results = [None] * world_size
+
+    def _worker(rank):
+        ctxs[rank].configure(f"{store.addr}/{prefix}", rank, world_size)
+        results[rank] = fn(ctxs[rank], rank)
+
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        futs = [pool.submit(_worker, r) for r in range(world_size)]
+        for f in futs:
+            f.result(timeout=timeout)
+    for ctx in ctxs:
+        ctx.shutdown()
+    return results
+
+
+@pytest.mark.parametrize("world_size", [2, 3, 4])
+def test_ring_allreduce_matches_star(store, world_size) -> None:
+    def _fn(ctx, rank):
+        a = np.arange(10, dtype=np.float32) * (rank + 1)
+        b = np.full((3, 5), float(rank), dtype=np.float64)
+        return ctx.allreduce([a, b]).future().result(timeout=15)
+
+    results = _run_ring(store, world_size, _fn)
+    total = sum(range(1, world_size + 1))
+    for res in results:
+        np.testing.assert_allclose(res[0], np.arange(10) * total)
+        np.testing.assert_allclose(
+            res[1], np.full((3, 5), sum(range(world_size)))
+        )
+
+
+def test_ring_allreduce_avg_and_uneven_sizes(store) -> None:
+    def _fn(ctx, rank):
+        # 7 elements across 3 ranks: uneven chunking
+        avg = ctx.allreduce(
+            [np.full(7, float(rank), np.float32)], op=ReduceOp.AVG
+        ).future().result(timeout=15)
+        return avg
+
+    for res in _run_ring(store, 3, _fn):
+        np.testing.assert_allclose(res[0], np.full(7, 1.0))
+
+
+def test_ring_broadcast_and_allgather(store) -> None:
+    def _fn(ctx, rank):
+        bc = ctx.broadcast(
+            [np.full(4, float(rank * 10 + 3), np.float32)], root=2
+        ).future().result(timeout=15)
+        ag = ctx.allgather(
+            [np.arange(rank + 1, dtype=np.int32)]
+        ).future().result(timeout=15)
+        return bc, ag
+
+    for bc, ag in _run_ring(store, 3, _fn):
+        np.testing.assert_allclose(bc[0], np.full(4, 23.0))
+        assert len(ag) == 3
+        for r in range(3):
+            np.testing.assert_array_equal(ag[r][0], np.arange(r + 1))
+
+
+def test_ring_sequential_ops_and_reconfigure(store) -> None:
+    def _fn(ctx, rank):
+        outs = []
+        for i in range(4):
+            w = ctx.allreduce([np.full(5, float(i + rank), np.float32)])
+            outs.append(w)
+        return [w.future().result(timeout=15)[0][0] for w in outs]
+
+    res = _run_ring(store, 3, _fn, prefix="ringseq")
+    assert res[0] == res[1] == res[2]
+
+    # auto mode picks ring for >= 3 ranks
+    ctx = TcpCommContext(timeout=5.0, algorithm="auto")
+    ctx.configure(f"{store.addr}/auto1", 0, 1)
+    assert not ctx._use_ring
+    ctx.shutdown()

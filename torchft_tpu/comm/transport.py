@@ -6,8 +6,10 @@ the data-center network between hosts, so the equivalent is a host-side
 socket transport that is rebuilt per quorum from the rendezvous store:
 
     configure(store_addr, rank, world_size):
-        rank 0 binds an ephemeral listener and publishes it in the store;
-        other ranks connect. Star topology: rank 0 reduces and fans out.
+        endpoints rendezvous through the store; two wire topologies —
+        "star" (rank 0 reduces and fans out; lowest latency for tiny
+        payloads) and "ring" (bandwidth-optimal reduce-scatter +
+        all-gather), selected per context ("auto" picks ring at >= 3).
 
 Every collective is queued onto one transport thread per context and
 processed strictly in issue order (the usual collective contract: all ranks
@@ -77,6 +79,47 @@ def _send_arrays(sock: socket.socket, arrays: Sequence[np.ndarray]) -> None:
         sock.sendall(header + a.tobytes())
 
 
+def _pack_arrays(arrays: Sequence[np.ndarray]) -> bytes:
+    """In-memory version of _send_arrays' framing."""
+    parts = [struct.pack("<I", len(arrays))]
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        dt = a.dtype.str.encode()
+        parts.append(struct.pack("<H", len(dt)))
+        parts.append(dt)
+        parts.append(struct.pack("<B", a.ndim))
+        if a.ndim:
+            parts.append(struct.pack(f"<{a.ndim}q", *a.shape))
+        parts.append(struct.pack("<Q", a.nbytes))
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def _unpack_arrays(data: bytes) -> List[np.ndarray]:
+    offset = 0
+
+    def take(n: int) -> bytes:
+        nonlocal offset
+        out = data[offset: offset + n]
+        if len(out) != n:
+            raise ConnectionError("truncated array frame")
+        offset += n
+        return out
+
+    (count,) = struct.unpack("<I", take(4))
+    out: List[np.ndarray] = []
+    for _ in range(count):
+        (dlen,) = struct.unpack("<H", take(2))
+        dtype = np.dtype(take(dlen).decode())
+        (ndim,) = struct.unpack("<B", take(1))
+        shape = struct.unpack(f"<{ndim}q", take(8 * ndim)) if ndim else ()
+        (nbytes,) = struct.unpack("<Q", take(8))
+        out.append(
+            np.frombuffer(take(nbytes), dtype=dtype).reshape(shape).copy()
+        )
+    return out
+
+
 def _recv_arrays(sock: socket.socket) -> List[np.ndarray]:
     (n,) = struct.unpack("<I", _recv_exact(sock, 4))
     out: List[np.ndarray] = []
@@ -102,20 +145,33 @@ class _PendingOp:
 
 
 class TcpCommContext(CommContext):
-    """Reconfigurable star-topology collective context over TCP."""
+    """Reconfigurable collective context over TCP (star or ring wire
+    topology; see class ctor)."""
 
-    def __init__(self, timeout: "float | timedelta" = 60.0) -> None:
+    def __init__(self, timeout: "float | timedelta" = 60.0,
+                 algorithm: str = "auto") -> None:
+        """``algorithm``: "star" (rank 0 reduces and fans out — lowest
+        latency for tiny payloads / few replicas), "ring" (bandwidth-optimal
+        reduce-scatter + all-gather: each link moves ~2B/n per allreduce
+        instead of the star root's 2B·(n-1)), or "auto" (ring for
+        world_size >= 3)."""
         super().__init__()
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
+        if algorithm not in ("auto", "star", "ring"):
+            raise ValueError(f"unknown algorithm {algorithm!r}")
+        self._algorithm = algorithm
+        self._use_ring = False
         self._timeout = float(timeout)
         self._generation = 0
         self._lock = threading.Lock()
         self._queue: "queue.Queue[Optional[_PendingOp]]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._listener: Optional[socket.socket] = None
-        self._peer_socks: Dict[int, socket.socket] = {}   # root only
-        self._root_sock: Optional[socket.socket] = None   # non-root only
+        self._peer_socks: Dict[int, socket.socket] = {}   # star: root only
+        self._root_sock: Optional[socket.socket] = None   # star: non-root
+        self._next_sock: Optional[socket.socket] = None   # ring
+        self._prev_sock: Optional[socket.socket] = None   # ring
         self._error: Optional[Exception] = None
         self._seq = 0
 
@@ -140,6 +196,16 @@ class TcpCommContext(CommContext):
             return
 
         store = create_store_client(store_addr, timeout=self._timeout)
+        self._use_ring = self._algorithm == "ring" or (
+            self._algorithm == "auto" and world_size >= 3
+        )
+        if self._use_ring:
+            self._configure_ring(store, rank, world_size)
+            self._thread = threading.Thread(
+                target=self._run_loop, name="torchft_tpu_comm", daemon=True
+            )
+            self._thread.start()
+            return
         if rank == 0:
             listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -199,6 +265,14 @@ class TcpCommContext(CommContext):
             except OSError:
                 pass
         self._peer_socks = {}
+        for attr in ("_next_sock", "_prev_sock"):
+            s = getattr(self, attr)
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                setattr(self, attr, None)
         if self._root_sock is not None:
             try:
                 self._root_sock.close()
@@ -285,7 +359,9 @@ class TcpCommContext(CommContext):
                 return [p.arrays]
             return p.arrays
 
-    # Star protocol frame (peer->root): [opcode u8][seq u64][op u8] + arrays.
+        if self._use_ring:
+            return self._execute_ring(p)
+        # Star protocol frame (peer->root): [opcode u8][seq u64][op u8] + arrays.
         if self._rank == 0:
             return self._execute_root(p)
         return self._execute_peer(p)
@@ -363,3 +439,216 @@ class TcpCommContext(CommContext):
                 idx += n
             return gathered
         return result
+
+    # ---------------------------------------------------------- ring variant
+
+    def _configure_ring(self, store, rank: int, world_size: int) -> None:
+        """Ring rendezvous: every rank publishes a listener; rank r dials
+        (r+1) % n and accepts one connection from (r-1) % n."""
+        from torchft_tpu.utils.net import advertised_host
+
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("0.0.0.0", 0))
+        listener.listen(4)
+        listener.settimeout(self._timeout)
+        self._listener = listener
+        store.set(
+            f"ring_addr_{rank}",
+            f"{advertised_host()}:{listener.getsockname()[1]}",
+        )
+
+        next_rank = (rank + 1) % world_size
+        addr = store.wait(
+            f"ring_addr_{next_rank}", timeout=self._timeout
+        ).decode()
+        host, port_s = addr.rsplit(":", 1)
+        try:
+            next_sock = socket.create_connection(
+                (host, int(port_s)), timeout=self._timeout
+            )
+            next_sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            next_sock.settimeout(self._timeout)
+            next_sock.sendall(struct.pack("<I", rank))
+            prev_sock, _ = listener.accept()
+            prev_sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            prev_sock.settimeout(self._timeout)
+            (prev_rank,) = struct.unpack("<I", _recv_exact(prev_sock, 4))
+        except (OSError, socket.timeout) as e:
+            listener.close()
+            raise TimeoutError(
+                f"ring configure: rank {rank} could not link the ring: {e}"
+            ) from e
+        expected_prev = (rank - 1) % world_size
+        if prev_rank != expected_prev:
+            prev_sock.close()
+            next_sock.close()
+            listener.close()
+            raise ConnectionError(
+                f"ring configure: rank {rank} accepted rank {prev_rank}, "
+                f"expected {expected_prev} (stale round?)"
+            )
+        self._next_sock = next_sock
+        self._prev_sock = prev_sock
+
+    _RING_HDR = struct.Struct("<BQHQ")  # opcode, seq, step, payload bytes
+
+    def _ring_sendrecv(self, opcode: int, step: int, payload: bytes) -> bytes:
+        """Full-duplex one-step exchange: push to next while pulling from
+        prev (a sender thread avoids deadlock once payloads exceed socket
+        buffers). Every frame carries [opcode][seq][step][nbytes] and the
+        receiver validates it — a desynced collective sequence fails fast
+        instead of silently reducing misaligned bytes (parity with the
+        star path's mismatch check)."""
+        next_sock, prev_sock = self._next_sock, self._prev_sock
+        assert next_sock is not None and prev_sock is not None
+        send_err: List[Optional[Exception]] = [None]
+        header = self._RING_HDR.pack(opcode, self._seq, step, len(payload))
+
+        def _send() -> None:
+            try:
+                next_sock.sendall(header + payload)
+            except Exception as e:  # noqa: BLE001
+                send_err[0] = e
+
+        sender = threading.Thread(target=_send, daemon=True)
+        sender.start()
+        try:
+            r_op, r_seq, r_step, r_len = self._RING_HDR.unpack(
+                _recv_exact(prev_sock, self._RING_HDR.size)
+            )
+            if (r_op, r_seq, r_step) != (opcode, self._seq, step):
+                raise ConnectionError(
+                    f"ring collective mismatch: got op={r_op} seq={r_seq} "
+                    f"step={r_step}, expected op={opcode} seq={self._seq} "
+                    f"step={step}"
+                )
+            data = _recv_exact(prev_sock, r_len)
+        finally:
+            sender.join(timeout=self._timeout)
+        if send_err[0] is not None:
+            raise send_err[0]
+        if sender.is_alive():
+            raise TimeoutError("ring send stalled")
+        return data
+
+    @staticmethod
+    def _chunk_bounds(total: int, n: int, c: int) -> "tuple[int, int]":
+        """Element bounds of chunk c when splitting `total` into n
+        near-equal parts (first total % n chunks get one extra)."""
+        base, extra = divmod(total, n)
+        start = c * base + min(c, extra)
+        return start, start + base + (1 if c < extra else 0)
+
+    def _execute_ring(self, p: _PendingOp):
+        n, r = self._world_size, self._rank
+        if p.opcode == _OP_ALLREDUCE:
+            return self._ring_allreduce(p)
+        if p.opcode == _OP_BROADCAST:
+            # forward whole payload around the ring, root first; frames
+            # carry the seq header so desyncs fail fast
+            hdr = self._RING_HDR
+            if r == p.root:
+                payload = _pack_arrays(p.arrays)
+                self._next_sock.sendall(
+                    hdr.pack(_OP_BROADCAST, self._seq, 0, len(payload))
+                    + payload
+                )
+                return [np.array(a, copy=True) for a in p.arrays]
+            r_op, r_seq, _, r_len = hdr.unpack(
+                _recv_exact(self._prev_sock, hdr.size)
+            )
+            if (r_op, r_seq) != (_OP_BROADCAST, self._seq):
+                raise ConnectionError(
+                    f"ring broadcast mismatch: got op={r_op} seq={r_seq}, "
+                    f"expected op={_OP_BROADCAST} seq={self._seq}"
+                )
+            payload = _recv_exact(self._prev_sock, r_len)
+            if (r + 1) % n != p.root:
+                self._next_sock.sendall(
+                    hdr.pack(_OP_BROADCAST, self._seq, 0, len(payload))
+                    + payload
+                )
+            return _unpack_arrays(payload)
+        if p.opcode == _OP_ALLGATHER:
+            # rotate contributions n-1 times; slot by source rank
+            gathered: List[Optional[List[np.ndarray]]] = [None] * n
+            gathered[r] = [np.array(a, copy=True) for a in p.arrays]
+            current_bytes = _pack_arrays(gathered[r])
+            for step in range(n - 1):
+                src = (r - step - 1) % n
+                current_bytes = self._ring_sendrecv(
+                    _OP_ALLGATHER, step, current_bytes
+                )
+                gathered[src] = _unpack_arrays(current_bytes)
+            return gathered
+        raise ValueError(f"unknown opcode {p.opcode}")
+
+    def _ring_allreduce(self, p: _PendingOp):
+        """Bandwidth-optimal allreduce: reduce-scatter then all-gather,
+        2(n-1) steps moving ~1/n of the payload each."""
+        n, r = self._world_size, self._rank
+        reduce_fn = _REDUCE_FNS.get(
+            ReduceOp.SUM if p.op == ReduceOp.AVG else p.op
+        )
+        if reduce_fn is None:
+            raise ValueError(f"unsupported reduce op: {p.op}")
+
+        out = [np.array(np.ascontiguousarray(a), copy=True) for a in p.arrays]
+        flats = [a.reshape(-1) for a in out]
+
+        def chunk_views(c: int) -> List[np.ndarray]:
+            views = []
+            for f in flats:
+                s, e = self._chunk_bounds(f.size, n, c)
+                views.append(f[s:e])
+            return views
+
+        def pack(views: List[np.ndarray]) -> bytes:
+            return b"".join(v.tobytes() for v in views)
+
+        def unpack_into(data: bytes, views: List[np.ndarray], combine) -> None:
+            offset = 0
+            for v in views:
+                nb = v.nbytes
+                incoming = np.frombuffer(
+                    data[offset: offset + nb], dtype=v.dtype
+                )
+                combine(v, incoming)
+                offset += nb
+
+        # reduce-scatter: after step s, chunk (r - s) was sent onward and
+        # chunk (r - s - 1) absorbed; rank r ends owning chunk (r + 1) % n.
+        for step in range(n - 1):
+            send_c = (r - step) % n
+            recv_c = (r - step - 1) % n
+            send_views = chunk_views(send_c)
+            recv_views = chunk_views(recv_c)
+            data = self._ring_sendrecv(_OP_ALLREDUCE, step, pack(send_views))
+            if len(data) != sum(v.nbytes for v in recv_views):
+                raise ConnectionError(
+                    "ring allreduce chunk size mismatch (divergent shapes?)"
+                )
+            unpack_into(data, recv_views, reduce_fn)
+
+        # all-gather of the completed chunks
+        for step in range(n - 1):
+            send_c = (r + 1 - step) % n
+            recv_c = (r - step) % n
+            send_views = chunk_views(send_c)
+            recv_views = chunk_views(recv_c)
+            data = self._ring_sendrecv(
+                _OP_ALLREDUCE, n - 1 + step, pack(send_views)
+            )
+            if len(data) != sum(v.nbytes for v in recv_views):
+                raise ConnectionError(
+                    "ring allreduce chunk size mismatch (divergent shapes?)"
+                )
+            unpack_into(
+                data, recv_views, lambda v, inc: np.copyto(v, inc)
+            )
+
+        if p.op == ReduceOp.AVG:
+            for f in flats:
+                np.divide(f, n, out=f)
+        return out

@@ -63,6 +63,7 @@ class LocalSGD:
         self._params_fn = params_fn
         self._local_step = 0
         self._backup: Optional[Any] = None
+        self._healed_backup = False
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -82,6 +83,21 @@ class LocalSGD:
 
     def _save_backup(self, params: Any) -> None:
         self._backup = _to_host_copy(params)
+
+    # -- checkpoint surface --------------------------------------------------
+    # The wrapper's backup IS part of the training state: a healing replica
+    # must receive the donor's sync point, not re-derive one, or the first
+    # post-heal sync diverges (the reference checkpoints backup_params the
+    # same way, ref manager_integ_test.py:278-290). Include these in the
+    # state_dict/load_state_dict functions given to the Manager.
+
+    def state_dict(self) -> dict:
+        return {"backup": self._backup, "local_step": self._local_step}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._backup = state["backup"]
+        self._local_step = state["local_step"]
+        self._healed_backup = True
 
     def restore(self) -> Any:
         """The last committed (synced) params, as device arrays."""
@@ -116,7 +132,12 @@ class LocalSGD:
             # the caller's stale params (see ctor docstring).
             if self._params_fn is not None:
                 params = self._params_fn()
-                self._save_backup(params)
+                if self._healed_backup:
+                    # the donor's backup came through load_state_dict —
+                    # keep it; it is the true sync point
+                    self._healed_backup = False
+                else:
+                    self._save_backup(params)
             else:
                 logger.warning(
                     "healed without params_fn: caller params may be stale "
@@ -170,6 +191,15 @@ class DiLoCo(LocalSGD):
 
     def load_outer_state(self, state: Any) -> None:
         self._outer_state = state
+
+    def state_dict(self) -> dict:
+        out = super().state_dict()
+        out["outer_state"] = self._outer_state
+        return out
+
+    def load_state_dict(self, state: dict) -> None:
+        super().load_state_dict(state)
+        self._outer_state = state["outer_state"]
 
     def _perform_sync(self, params: Any) -> Any:
         import jax
