@@ -16,13 +16,71 @@ chaos on a v5e-64 — here it is the single-chip FT overhead ratio).
 
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
+def _devices_or_fallback() -> None:
+    """Time-boxed accelerator init. The axon TPU tunnel is single-tenant
+    and a stale claim from a killed process can wedge jax.devices()
+    indefinitely; rather than hang the driver, fall back to a CPU run in a
+    clean subprocess (the JSON reports which backend actually measured)."""
+    if os.environ.get("BENCH_NO_FALLBACK"):
+        return
+    budget = float(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
+    result = {}
+
+    def _probe() -> None:
+        try:
+            import jax
+
+            result["devices"] = jax.devices()
+        except Exception as e:  # noqa: BLE001
+            result["error"] = e
+
+    t = threading.Thread(target=_probe, daemon=True)
+    t.start()
+    t.join(budget)
+    if "devices" in result:
+        return
+    if "error" in result:
+        sys.stderr.write(
+            f"bench: accelerator init failed ({result['error']!r}); "
+            "re-running on CPU\n"
+        )
+    else:
+        sys.stderr.write(
+            f"bench: accelerator init did not finish in {budget}s; "
+            "re-running on CPU\n"
+        )
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("PYTHONPATH", "JAX_PLATFORMS")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_NO_FALLBACK"] = "1"
+    env.setdefault("BENCH_MODEL", "tiny")  # CPU can't push 125m quickly
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env,
+        capture_output=True,
+        text=True,
+    )
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    # hard-exit (the stuck probe thread would keep the process alive) —
+    # but flush first: os._exit skips buffer flushing
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(proc.returncode)
+
+
 def main() -> None:
+    _devices_or_fallback()
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -33,7 +91,12 @@ def main() -> None:
     from torchft_tpu.control import Lighthouse
     from torchft_tpu.ddp import DistributedDataParallel
     from torchft_tpu.manager import Manager
-    from torchft_tpu.models import CONFIGS, init_params, make_grad_step
+    from torchft_tpu.models import (
+        CONFIGS,
+        count_params,
+        init_params,
+        make_grad_step,
+    )
     from torchft_tpu.optim import OptimizerWrapper
 
     model_name = os.environ.get("BENCH_MODEL", "125m")
@@ -46,6 +109,7 @@ def main() -> None:
 
     key = jax.random.key(0)
     params = init_params(cfg, key)
+    n_params = count_params(params)
     tx = optax.adamw(3e-4, weight_decay=0.01)
 
     rng = np.random.default_rng(0)
@@ -139,7 +203,7 @@ def main() -> None:
                 "fault_free_tokens_per_sec": round(t0, 1),
                 "commit_rate": committed / max(1, attempted),
                 "model": model_name,
-                "params_m": None,
+                "params_m": round(n_params / 1e6, 1),
                 "batch": batch,
                 "seq_len": cfg.max_seq_len,
                 "backend": jax.default_backend(),

@@ -295,3 +295,25 @@ def test_ring_sequential_ops_and_reconfigure(store) -> None:
     ctx.configure(f"{store.addr}/auto1", 0, 1)
     assert not ctx._use_ring
     ctx.shutdown()
+
+
+@pytest.mark.parametrize("world_size,expect_ring", [(2, False), (3, True)])
+def test_auto_algorithm_selection(store, world_size, expect_ring) -> None:
+    ctxs = [TcpCommContext(timeout=10.0, algorithm="auto")
+            for _ in range(world_size)]
+
+    def _fn(rank):
+        ctxs[rank].configure(f"{store.addr}/autosel", rank, world_size)
+        return ctxs[rank].allreduce(
+            [np.full(3, float(rank + 1), np.float32)]
+        ).future().result(timeout=15)
+
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        results = [f.result(timeout=30)
+                   for f in [pool.submit(_fn, r) for r in range(world_size)]]
+    total = sum(range(1, world_size + 1))
+    for res in results:
+        np.testing.assert_allclose(res[0], np.full(3, total))
+    for ctx in ctxs:
+        assert ctx._use_ring == expect_ring
+        ctx.shutdown()

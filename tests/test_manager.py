@@ -392,3 +392,18 @@ def test_quorum_timeout_plumbing(store) -> None:
     manager.wait_quorum()
     assert client.quorum.call_args.kwargs["timeout"] == 12.5
     manager.shutdown(wait=False)
+
+
+def test_metrics_populated(store) -> None:
+    manager, client, comm, _ = make_manager(store)
+    client.quorum.return_value = quorum_result()
+    client.should_commit.return_value = True
+    manager.start_quorum()
+    manager.allreduce_arrays([np.ones(2, np.float32)]).future().result(5)
+    manager.should_commit()
+    snap = manager.metrics.snapshot()
+    assert snap["steps_committed"] == 1
+    assert "quorum_avg_ms" in snap
+    assert "allreduce_avg_ms" in snap
+    assert "commit_barrier_avg_ms" in snap
+    manager.shutdown(wait=False)

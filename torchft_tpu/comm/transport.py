@@ -61,22 +61,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _send_arrays(sock: socket.socket, arrays: Sequence[np.ndarray]) -> None:
-    # Per-array [dtype][ndim][shape][nbytes] header immediately followed by
-    # its payload, matching _recv_arrays' read order.
-    sock.sendall(struct.pack("<I", len(arrays)))
-    for a in arrays:
-        a = np.ascontiguousarray(a)
-        dt = a.dtype.str.encode()
-        header = b"".join(
-            (
-                struct.pack("<H", len(dt)),
-                dt,
-                struct.pack("<B", a.ndim),
-                struct.pack(f"<{a.ndim}q", *a.shape) if a.ndim else b"",
-                struct.pack("<Q", a.nbytes),
-            )
-        )
-        sock.sendall(header + a.tobytes())
+    # Single framing definition: see _pack_arrays.
+    sock.sendall(_pack_arrays(arrays))
 
 
 def _pack_arrays(arrays: Sequence[np.ndarray]) -> bytes:
@@ -121,6 +107,8 @@ def _unpack_arrays(data: bytes) -> List[np.ndarray]:
 
 
 def _recv_arrays(sock: socket.socket) -> List[np.ndarray]:
+    # Streaming reader for _pack_arrays' framing (kept separate so huge
+    # payloads aren't double-buffered into one bytes object on receive).
     (n,) = struct.unpack("<I", _recv_exact(sock, 4))
     out: List[np.ndarray] = []
     for _ in range(n):

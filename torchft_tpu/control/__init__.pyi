@@ -1,0 +1,83 @@
+"""Typed surface of the native control plane (parity target:
+/root/reference/torchft/torchft.pyi)."""
+
+from datetime import timedelta
+from typing import List, Optional
+
+class QuorumResult:
+    quorum_id: int
+    replica_rank: int
+    replica_world_size: int
+    recover_src_manager_address: str
+    recover_src_rank: Optional[int]
+    recover_dst_ranks: List[int]
+    store_address: str
+    max_step: int
+    max_rank: Optional[int]
+    max_world_size: int
+    heal: bool
+
+class ManagerClient:
+    def __init__(
+        self, addr: str, connect_timeout: "float | timedelta" = ...
+    ) -> None: ...
+    def quorum(
+        self,
+        rank: int,
+        step: int,
+        checkpoint_metadata: str,
+        shrink_only: bool,
+        timeout: "float | timedelta",
+    ) -> QuorumResult: ...
+    def checkpoint_metadata(
+        self, rank: int, timeout: "float | timedelta"
+    ) -> str: ...
+    def should_commit(
+        self,
+        rank: int,
+        step: int,
+        should_commit: bool,
+        timeout: "float | timedelta",
+    ) -> bool: ...
+    def kill(
+        self, msg: str = ..., timeout: "float | timedelta" = ...
+    ) -> None: ...
+
+class ManagerServer:
+    def __init__(
+        self,
+        replica_id: str,
+        lighthouse_addr: str,
+        hostname: Optional[str] = ...,
+        bind: str = ...,
+        store_addr: str = ...,
+        world_size: int = ...,
+        heartbeat_interval: "float | timedelta" = ...,
+        connect_timeout: "float | timedelta" = ...,
+        exit_on_kill: bool = ...,
+    ) -> None: ...
+    def address(self) -> str: ...
+    def kill_requested(self) -> bool: ...
+    def shutdown(self) -> None: ...
+
+class Lighthouse:
+    def __init__(
+        self,
+        bind: str = ...,
+        min_replicas: int = ...,
+        join_timeout_ms: Optional[int] = ...,
+        quorum_tick_ms: Optional[int] = ...,
+        heartbeat_timeout_ms: Optional[int] = ...,
+        hostname: str = ...,
+    ) -> None: ...
+    def address(self) -> str: ...
+    def shutdown(self) -> None: ...
+
+def lighthouse_heartbeat(
+    lighthouse_addr: str, replica_id: str,
+    timeout: "float | timedelta" = ...,
+) -> None: ...
+def lighthouse_quorum(
+    lighthouse_addr: str, requester: dict,
+    timeout: "float | timedelta" = ...,
+) -> dict: ...

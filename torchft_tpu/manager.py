@@ -48,6 +48,7 @@ from torchft_tpu.comm.context import (
 from torchft_tpu.comm.store import StoreClient
 from torchft_tpu.control import ManagerClient, ManagerServer
 from torchft_tpu.futures import future_chain, future_timeout
+from torchft_tpu.utils.metrics import Metrics
 
 logger = logging.getLogger(__name__)
 
@@ -179,6 +180,7 @@ class Manager:
         self._participating_world_size: int = 0
         self._replica_world_size: int = 0
         self._did_heal = False
+        self.metrics = Metrics()
 
     # ------------------------------------------------------------- lifecycle
 
@@ -226,9 +228,15 @@ class Manager:
             arrays = [np.zeros_like(a) for a in arrays]
 
         try:
+            import time as _time
+
+            submit_time = _time.perf_counter()
             work = self._comm.allreduce(arrays, op)
 
             def _normalize(f: Future) -> List[np.ndarray]:
+                self.metrics.observe(
+                    "allreduce", _time.perf_counter() - submit_time
+                )
                 reduced = f.result()  # raises into wrap future on error
                 if op != ReduceOp.SUM:
                     # AVG is already divided by the transport; MAX/MIN must
@@ -347,7 +355,12 @@ class Manager:
     def _async_quorum(
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
     ) -> None:
-        quorum = self._client.quorum(
+        with self.metrics.timed("quorum"):
+            quorum = self._quorum_rpc(allow_heal, shrink_only, quorum_timeout)
+        self._finish_quorum(quorum, allow_heal)
+
+    def _quorum_rpc(self, allow_heal, shrink_only, quorum_timeout):
+        return self._client.quorum(
             rank=self._rank,
             step=self._step,
             checkpoint_metadata=self._checkpoint_transport.metadata(),
@@ -355,6 +368,7 @@ class Manager:
             timeout=quorum_timeout,
         )
 
+    def _finish_quorum(self, quorum, allow_heal: bool) -> None:
         # Async quorum: only the up-to-date (max-step) cohort participates —
         # healing replicas contribute zeros this step. Sync quorum (or
         # allow_heal=False): everyone in the quorum participates
@@ -492,15 +506,24 @@ class Manager:
 
         enough_replicas = self.num_participants() >= self._min_replica_size
         local_should_commit = enough_replicas and self.errored() is None
+        import time as _time
+
+        commit_start = _time.perf_counter()
         should_commit = self._client.should_commit(
             self._rank,
             self._step,
             local_should_commit,
             timeout=_seconds(timeout) if timeout else self._timeout,
         )
+        self.metrics.observe(
+            "commit_barrier", _time.perf_counter() - commit_start
+        )
         self._logger.info(
             f"should_commit={should_commit} enough_replicas={enough_replicas} "
             f"errored={self.errored()}"
+        )
+        self.metrics.incr(
+            "steps_committed" if should_commit else "steps_discarded"
         )
 
         self._checkpoint_transport.disallow_checkpoint()

@@ -1,0 +1,58 @@
+"""Lightweight in-process metrics.
+
+The reference ships no metrics beyond logs and the dashboard (SURVEY.md §5
+"no Prometheus endpoint"); this is a TPU-native extra: cheap counters and
+rolling timings the Manager updates per step, exposed as a dict for the
+user's own metrics pipeline (and printed by examples). Zero overhead when
+not read: plain floats under a lock, no exporter threads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict, deque
+from contextlib import contextmanager
+from typing import Deque, Dict
+
+__all__ = ["Metrics"]
+
+
+class Metrics:
+    """Counters + rolling-window timers keyed by name."""
+
+    def __init__(self, window: int = 128) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = defaultdict(float)
+        self._timings: Dict[str, Deque[float]] = defaultdict(
+            lambda: deque(maxlen=window)
+        )
+
+    def incr(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._timings[name].append(seconds)
+
+    @contextmanager
+    def timed(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - start)
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat dict: counters as-is; timings as name_avg_ms / name_p max."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            out.update(self._counters)
+            for name, window in self._timings.items():
+                if window:
+                    out[f"{name}_avg_ms"] = (
+                        sum(window) / len(window) * 1000.0
+                    )
+                    out[f"{name}_max_ms"] = max(window) * 1000.0
+        return out
