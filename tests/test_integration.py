@@ -330,3 +330,79 @@ def test_recovery_with_sync_quorum() -> None:
     _assert_trajectories_consistent(runners)
     for r in runners:
         assert max(r.history) >= 6
+
+
+def test_multi_rank_groups() -> None:
+    # 2 replica groups x 2 local ranks: the manager server fans in both
+    # local ranks before one lighthouse RPC; each local rank forms its own
+    # cross-group comm under {store}/torchft/{qid}/{rank}
+    # (ref manager_integ_test.py:431-470 multi-rank groups).
+    lighthouse = Lighthouse(min_replicas=2, join_timeout_ms=300)
+    num_groups, ranks_per_group = 2, 2
+    results = {}
+    errors = []
+
+    def worker(group, rank, group_stores):
+        try:
+            store_addr = group_stores[group]
+            state = {"w": np.zeros(4, dtype=np.float32)}
+            manager = Manager(
+                comm=TcpCommContext(timeout=10.0),
+                load_state_dict=lambda sd: state.update(sd),
+                state_dict=lambda: dict(state),
+                min_replica_size=2,
+                rank=rank,
+                world_size=ranks_per_group,
+                store_addr=store_addr,
+                lighthouse_addr=lighthouse.address(),
+                replica_id=f"mr_{group}_",
+                timeout=10.0, quorum_timeout=15.0, connect_timeout=10.0,
+                heartbeat_interval=0.05,
+            )
+            try:
+                for _ in range(3):
+                    manager.start_quorum()
+                    # rank-dependent grads: counterpart ranks across groups
+                    # average among themselves
+                    grad = np.full(4, float(group * 10 + rank), np.float32)
+                    avg = manager.allreduce_arrays([grad]).future().result(
+                        timeout=30
+                    )[0]
+                    committed = manager.should_commit()
+                    results[(group, rank, manager.current_step())] = (
+                        avg.copy(), committed
+                    )
+            finally:
+                manager.shutdown(wait=False)
+        except Exception as e:  # noqa: BLE001
+            errors.append((group, rank, e))
+
+    stores = [StoreServer() for _ in range(num_groups)]
+    group_stores = [s.addr for s in stores]
+    try:
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            futs = [
+                pool.submit(worker, g, r, group_stores)
+                for g in range(num_groups)
+                for r in range(ranks_per_group)
+            ]
+            for f in futs:
+                f.result(timeout=120)
+    finally:
+        lighthouse.shutdown()
+        for s in stores:
+            s.shutdown()
+
+    assert not errors, errors
+    for (group, rank, step), (avg, committed) in results.items():
+        assert committed, (group, rank, step)
+        if step >= 2:
+            # post-bootstrap: rank r of group 0 averages with rank r of
+            # group 1: avg = (0*10+r + 1*10+r)/2 = 5 + r. (Step 1 is the
+            # step-0 bootstrap where the non-primary group heals and
+            # contributes zeros — and the per-rank primary spread means
+            # rank 0 and rank 1 heal OPPOSITE groups, by design:
+            # ref manager.rs:397-399.)
+            np.testing.assert_allclose(avg, np.full(4, 5.0 + rank))
+    steps_seen = {s for (_, _, s) in results}
+    assert {1, 2, 3} <= steps_seen

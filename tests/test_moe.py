@@ -1,0 +1,113 @@
+"""Expert-parallel MoE tests: routing correctness, capacity drops,
+sharded == unsharded on an expert mesh."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from torchft_tpu.parallel import ft_mesh, shard_pytree
+from torchft_tpu.parallel.moe import (
+    MoEConfig,
+    init_moe_params,
+    moe_forward,
+    moe_rules,
+)
+
+
+CFG = MoEConfig(d_model=16, d_ff=32, num_experts=4, capacity_factor=2.0)
+
+
+def _x(shape=(2, 8, 16), seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(shape), dtype=jnp.float32)
+
+
+def test_moe_forward_shapes_and_aux() -> None:
+    params = init_moe_params(jax.random.key(0), CFG)
+    x = _x()
+    y, aux = moe_forward(CFG, params, x)
+    assert y.shape == x.shape
+    assert np.isfinite(float(aux))
+    # perfectly balanced top-1 routing gives aux == 1.0; anything routed
+    # produces aux >= 1 by Cauchy-Schwarz — sanity-bound it
+    assert 0.9 < float(aux) < CFG.num_experts + 0.1
+
+
+def test_moe_matches_dense_reference() -> None:
+    # With generous capacity (nothing dropped), the MoE output must equal
+    # explicitly computing each token through its top-2 experts.
+    cfg = MoEConfig(d_model=8, d_ff=16, num_experts=4, capacity_factor=8.0)
+    params = init_moe_params(jax.random.key(1), cfg)
+    x = _x((1, 6, 8), seed=3)
+    y, _ = moe_forward(cfg, params, x)
+
+    tokens = np.asarray(x).reshape(-1, 8)
+    gates = np.asarray(
+        jax.nn.softmax(tokens @ np.asarray(params["gate"]["kernel"]), axis=-1)
+    )
+    up = np.asarray(params["experts"]["up"])
+    down = np.asarray(params["experts"]["down"])
+    expected = np.zeros_like(tokens)
+    for i, tok in enumerate(tokens):
+        order = np.argsort(gates[i])[::-1][:2]
+        w = gates[i][order]
+        w = w / w.sum()
+        for e, weight in zip(order, w):
+            h = np.asarray(jax.nn.gelu(tok @ up[e]))
+            expected[i] += weight * (h @ down[e])
+    np.testing.assert_allclose(
+        np.asarray(y).reshape(-1, 8), expected, atol=1e-5, rtol=1e-4
+    )
+
+
+def test_moe_capacity_drops_tokens() -> None:
+    # capacity 1 per expert with many tokens: most tokens dropped -> output
+    # rows become zero for dropped tokens (residual passthrough upstream)
+    cfg = MoEConfig(d_model=8, d_ff=16, num_experts=2,
+                    capacity_factor=0.05)
+    params = init_moe_params(jax.random.key(2), cfg)
+    x = _x((1, 32, 8), seed=4)
+    y, _ = moe_forward(cfg, params, x)
+    zero_rows = np.sum(
+        np.all(np.abs(np.asarray(y).reshape(-1, 8)) < 1e-9, axis=-1)
+    )
+    assert zero_rows > 0
+
+
+def test_moe_sharded_expert_mesh_matches() -> None:
+    mesh = ft_mesh({"expert": 4, "data": 2})
+    params = init_moe_params(jax.random.key(0), CFG)
+    x = _x((4, 8, 16))
+    y_ref, aux_ref = moe_forward(CFG, params, x)
+
+    sharded = shard_pytree(
+        params, mesh, tp_rules=moe_rules(), fsdp_axis=None,
+        tensor_axis="expert",
+    )
+    assert sharded["experts"]["up"].sharding.spec[0] == "expert"
+    x_sharded = jax.device_put(
+        x, NamedSharding(mesh, P("data", None, None))
+    )
+    fn = jax.jit(lambda p, x: moe_forward(CFG, p, x))
+    y, aux = fn(sharded, x_sharded)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(y_ref), atol=1e-5, rtol=1e-4
+    )
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
+def test_moe_differentiable() -> None:
+    params = init_moe_params(jax.random.key(0), CFG)
+    x = _x()
+
+    def loss(p):
+        y, aux = moe_forward(CFG, p, x)
+        return jnp.sum(y ** 2) + 0.01 * aux
+
+    grads = jax.grad(loss)(params)
+    for leaf in jax.tree_util.tree_leaves(grads):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    # expert weights actually receive gradient
+    assert float(np.abs(np.asarray(grads["experts"]["up"])).max()) > 0

@@ -18,3 +18,9 @@ from torchft_tpu.parallel.sharding import (  # noqa: F401
     shard_pytree,
     tp_rules_gpt,
 )
+from torchft_tpu.parallel.moe import (  # noqa: F401
+    MoEConfig,
+    init_moe_params,
+    moe_forward,
+    moe_rules,
+)

@@ -1,0 +1,145 @@
+"""Expert parallelism: a capacity-based top-2 MoE feed-forward block.
+
+The reference has no MoE/EP machinery (SURVEY.md §2c: EP absent); this is
+a TPU-native first-class addition completing the in-group axis set
+(data / fsdp / tensor / seq / expert). The formulation is the GShard-style
+einsum dispatch/combine: routing builds dense [tokens, experts, capacity]
+dispatch/combine tensors, expert weights live sharded on the ``expert``
+mesh axis, and XLA inserts the all_to_alls when the dispatched activations
+cross from token-sharded to expert-sharded layout — no hand-written
+collectives, fully compiled, static shapes (capacity bounds the routing).
+
+    params = init_moe_params(key, cfg)
+    params = shard_pytree(params, mesh, tp_rules=moe_rules())   # E-dim shard
+    y, aux_loss = moe_forward(cfg, params, x)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+__all__ = ["MoEConfig", "init_moe_params", "moe_forward", "moe_rules"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int
+    d_ff: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    dtype: Any = None  # default: x.dtype
+
+
+def init_moe_params(key, cfg: MoEConfig) -> Dict:
+    import jax
+    import jax.numpy as jnp
+
+    kg, ku, kd = jax.random.split(key, 3)
+    scale_in = 1.0 / (cfg.d_model ** 0.5)
+    scale_out = 1.0 / (cfg.d_ff ** 0.5)
+    return {
+        "gate": {"kernel": jax.random.normal(
+            kg, (cfg.d_model, cfg.num_experts), jnp.float32) * scale_in},
+        "experts": {
+            "up": jax.random.normal(
+                ku, (cfg.num_experts, cfg.d_model, cfg.d_ff), jnp.float32
+            ) * scale_in,
+            "down": jax.random.normal(
+                kd, (cfg.num_experts, cfg.d_ff, cfg.d_model), jnp.float32
+            ) * scale_out,
+        },
+    }
+
+
+def moe_rules():
+    """TP-style path rules sharding expert weights on the ``expert`` axis
+    (feed to parallel.sharding.make_sharding_fn via tensor_axis="expert",
+    or merge with tp_rules_gpt for combined TP+EP)."""
+    return [
+        (r".*experts/(up|down)", 0),   # expert dim
+        (r".*gate.*", None),           # router replicated
+    ]
+
+
+def _top2_routing(gates, capacity: int):
+    """gates [N, E] -> dispatch [N, E, C] (0/1), combine [N, E, C]."""
+    import jax.numpy as jnp
+
+    n, e = gates.shape
+
+    idx1 = jnp.argmax(gates, axis=-1)                      # [N]
+    mask1 = jnp.eye(e, dtype=gates.dtype)[idx1]            # [N, E]
+    gates_wo1 = gates * (1.0 - mask1)
+    idx2 = jnp.argmax(gates_wo1, axis=-1)
+    mask2 = jnp.eye(e, dtype=gates.dtype)[idx2]
+
+    # queue position of each token within its expert (0-based), second
+    # choices queued after all first choices
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1
+    pos2 = (
+        jnp.cumsum(mask2, axis=0) + jnp.sum(mask1, axis=0, keepdims=True)
+    ) * mask2 - mask2
+    keep1 = mask1 * (pos1 < capacity)
+    keep2 = mask2 * (pos2 < capacity)
+
+    # renormalized top-2 weights for kept tokens
+    w1 = jnp.sum(gates * keep1, axis=-1)                    # [N]
+    w2 = jnp.sum(gates * keep2, axis=-1)
+    denom = jnp.maximum(w1 + w2, 1e-9)
+    w1, w2 = w1 / denom, w2 / denom
+
+    cap_iota = jnp.arange(capacity)
+
+    def one_hot_pos(pos, keep):
+        # [N, E, C]: 1 at (n, e, pos[n,e]) for kept entries
+        return keep[..., None] * (pos[..., None] == cap_iota)
+
+    d1 = one_hot_pos(pos1, keep1)
+    d2 = one_hot_pos(pos2, keep2)
+    dispatch = d1 + d2
+    combine = d1 * w1[:, None, None] + d2 * w2[:, None, None]
+    return dispatch, combine, mask1
+
+
+def moe_forward(cfg: MoEConfig, params: Dict, x) -> Tuple[Any, Any]:
+    """x [B, S, D] -> (y [B, S, D], aux_load_balancing_loss scalar).
+
+    Tokens over capacity are dropped (pass through the residual, standard
+    for capacity-based MoE). aux loss is the usual load-balancing term:
+    E * mean(fraction_routed_e * mean_gate_e).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    b, s, d = x.shape
+    n = b * s
+    dtype = cfg.dtype or x.dtype
+    tokens = x.reshape(n, d)
+
+    logits = tokens.astype(jnp.float32) @ params["gate"]["kernel"]
+    gates = jax.nn.softmax(logits, axis=-1)                 # [N, E]
+    capacity = max(
+        1, int(cfg.capacity_factor * n * 2 / cfg.num_experts)
+    )
+    dispatch, combine, mask1 = _top2_routing(gates, capacity)
+
+    # aux load-balancing loss (Switch/GShard style, on top-1 assignments)
+    frac_routed = jnp.mean(mask1, axis=0)                   # [E]
+    mean_gate = jnp.mean(gates, axis=0)                     # [E]
+    aux = cfg.num_experts * jnp.sum(frac_routed * mean_gate)
+
+    up = params["experts"]["up"].astype(dtype)
+    down = params["experts"]["down"].astype(dtype)
+    dispatch = dispatch.astype(dtype)
+    combine = combine.astype(dtype)
+    tokens = tokens.astype(dtype)
+
+    # dispatch: [N,E,C] x [N,D] -> [E,C,D] — sharded on E, XLA inserts the
+    # token->expert all_to_all here
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, tokens)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, up))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, down)
+    # combine: expert->token all_to_all back
+    out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+    return out.reshape(b, s, d).astype(x.dtype), aux
