@@ -24,3 +24,9 @@ from torchft_tpu.parallel.moe import (  # noqa: F401
     moe_forward,
     moe_rules,
 )
+from torchft_tpu.parallel.pipeline import (  # noqa: F401
+    make_pipeline,
+    merge_microbatches,
+    split_microbatches,
+    stack_stage_params,
+)

@@ -10,7 +10,8 @@ cross from token-sharded to expert-sharded layout — no hand-written
 collectives, fully compiled, static shapes (capacity bounds the routing).
 
     params = init_moe_params(key, cfg)
-    params = shard_pytree(params, mesh, tp_rules=moe_rules())   # E-dim shard
+    params = shard_pytree(params, mesh, tp_rules=moe_rules(),
+                          fsdp_axis=None, tensor_axis="expert")
     y, aux_loss = moe_forward(cfg, params, x)
 """
 
@@ -58,7 +59,9 @@ def moe_rules():
     or merge with tp_rules_gpt for combined TP+EP)."""
     return [
         (r".*experts/(up|down)", 0),   # expert dim
-        (r".*gate.*", None),           # router replicated
+        (r".*gate/kernel", None),      # router replicated (anchored so a
+                                       # transformer's gate_proj still gets
+                                       # its TP rule when rule lists merge)
     ]
 
 

@@ -1,0 +1,125 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over a mesh axis.
+
+The reference has no PP (SURVEY.md §2c). TPU-native addition completing the
+axis set (dp / fsdp / tp / seq / expert / stage). The design leans on jax's
+autodiff instead of hand-scheduling: the forward pipeline is an ordinary
+``lax.fori_loop`` of compute + ``ppermute`` hops under ``shard_map``, so
+``jax.grad`` through it yields the reverse pipeline automatically (the
+transpose of ppermute is the reverse rotation). Activations for the
+backward are rematerialized per the surrounding ``jax.checkpoint`` policy.
+
+Layout: every parameter leaf is stacked with a leading ``num_stages`` dim
+sharded over the ``stage`` axis; microbatches flow stage 0 → S-1 with a
+(M + S - 1)-tick schedule; outputs surface on the last stage and are
+psum-broadcast back.
+
+    mesh = ft_mesh({"stage": 4})
+    stacked = stack_stage_params([p0, p1, p2, p3])
+    pp = make_pipeline(mesh, stage_fn)     # stage_fn(stage_params, h) -> h
+    out = pp(stacked, microbatches)        # [M, mb, ...] -> [M, mb, ...]
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+__all__ = ["make_pipeline", "stack_stage_params", "split_microbatches",
+           "merge_microbatches"]
+
+
+def stack_stage_params(stage_params_list) -> Any:
+    """Stack per-stage param pytrees into one pytree with a leading
+    num_stages dim (shard it over the stage axis with
+    PartitionSpec(('stage',), ...))."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *stage_params_list
+    )
+
+
+def split_microbatches(x, num_microbatches: int):
+    """[B, ...] -> [M, B/M, ...]"""
+    b = x.shape[0]
+    assert b % num_microbatches == 0, (
+        f"batch {b} not divisible by {num_microbatches} microbatches"
+    )
+    return x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+
+
+def merge_microbatches(x):
+    """[M, mb, ...] -> [M*mb, ...]"""
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+
+
+def make_pipeline(mesh, stage_fn: Callable[[Any, Any], Any],
+                  axis: str = "stage"):
+    """Build a jittable pipelined apply: (stacked_params, microbatches) ->
+    outputs, where ``stage_fn(params_for_one_stage, h)`` is one stage's
+    compute and microbatches is [M, mb, ...]."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+
+        check_kwargs = {"check_vma": False}
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+
+        check_kwargs = {"check_rep": False}
+
+    num_stages = mesh.shape[axis]
+
+    def _body(stacked_params, x):
+        stage = lax.axis_index(axis)
+        # shard_map hands each device its [1, ...] slice of the stack
+        params = jax.tree_util.tree_map(lambda l: l[0], stacked_params)
+        num_mb = x.shape[0]
+        ticks = num_mb + num_stages - 1
+
+        state0 = jnp.zeros_like(x[0])
+        out0 = jnp.zeros_like(x)
+        perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+        def tick(t, carry):
+            state, out = carry
+            # stage 0 ingests microbatch t (clamped reads past the end are
+            # discarded by the schedule)
+            mb_in = lax.dynamic_index_in_dim(
+                x, jnp.clip(t, 0, num_mb - 1), axis=0, keepdims=False
+            )
+            h = stage_fn(
+                params, jnp.where(stage == 0, mb_in, state)
+            )
+            # the last stage completes microbatch t-(S-1) at this tick
+            mb_done = t - (num_stages - 1)
+            write_idx = jnp.clip(mb_done, 0, num_mb - 1)
+            should_write = (stage == num_stages - 1) & (mb_done >= 0)
+            current = lax.dynamic_index_in_dim(
+                out, write_idx, axis=0, keepdims=False
+            )
+            out = lax.dynamic_update_index_in_dim(
+                out,
+                jnp.where(should_write, h, current),
+                write_idx,
+                axis=0,
+            )
+            state = lax.ppermute(h, axis, perm)
+            return state, out
+
+        _, out = lax.fori_loop(0, ticks, tick, (state0, out0))
+        # outputs live on the last stage; zero elsewhere and psum-broadcast
+        out = jnp.where(stage == num_stages - 1, out, jnp.zeros_like(out))
+        return lax.psum(out, axis)
+
+    return shard_map(
+        _body,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        **check_kwargs,
+    )
