@@ -144,6 +144,11 @@ class DistributedDataParallel:
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         if not leaves:
             return completed_future(grads)
+        # Kick off all device->host DMAs before blocking on any of them so
+        # the transfers overlap (jax arrays expose async host copies).
+        for l in leaves:
+            if hasattr(l, "copy_to_host_async"):
+                l.copy_to_host_async()
         host = [np.asarray(jax.device_get(l)) for l in leaves]
         plan = self._get_plan(host)
         buckets = plan.pack(host)
