@@ -406,3 +406,25 @@ def test_multi_rank_groups() -> None:
             np.testing.assert_allclose(avg, np.full(4, 5.0 + rank))
     steps_seen = {s for (_, _, s) in results}
     assert {1, 2, 3} <= steps_seen
+
+
+def test_chaos_churn_five_replicas() -> None:
+    # The north-star scenario shape (BASELINE.md): repeated replica kills
+    # while the job keeps committing, every rejoiner healing back in.
+    runners, injectors = _run(
+        num_replicas=5,
+        total_steps=10,
+        fail_at=[(1, 2), (3, 4), (1, 6)],  # replica 1 dies twice
+        min_replicas=3,
+        timeout=150.0,
+    )
+    assert injectors[1].count == 2
+    assert injectors[3].count == 1
+    _assert_trajectories_consistent(runners)
+    for r in runners:
+        assert max(r.history) >= 10
+    # commit throughput stayed healthy: every replica committed most steps
+    for r in runners:
+        assert len(r.history) >= 6, (
+            f"replica {r.replica_id} committed only {len(r.history)} steps"
+        )

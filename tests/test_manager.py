@@ -407,3 +407,12 @@ def test_metrics_populated(store) -> None:
     assert "allreduce_avg_ms" in snap
     assert "commit_barrier_avg_ms" in snap
     manager.shutdown(wait=False)
+
+
+def test_shrink_only_plumbed_to_quorum(store) -> None:
+    manager, client, comm, _ = make_manager(store)
+    client.quorum.return_value = quorum_result()
+    manager.start_quorum(shrink_only=True)
+    manager.wait_quorum()
+    assert client.quorum.call_args.kwargs["shrink_only"] is True
+    manager.shutdown(wait=False)
