@@ -423,8 +423,12 @@ def test_chaos_churn_five_replicas() -> None:
     _assert_trajectories_consistent(runners)
     for r in runners:
         assert max(r.history) >= 10
-    # commit throughput stayed healthy: every replica committed most steps
+    # Never-killed replicas commit most steps; killed replicas legitimately
+    # commit fewer — a heal FAST-FORWARDS past the steps missed while dead
+    # (that is the point), so their history has gaps.
+    killed = {1, 3}
     for r in runners:
-        assert len(r.history) >= 6, (
+        floor = 3 if r.replica_id in killed else 6
+        assert len(r.history) >= floor, (
             f"replica {r.replica_id} committed only {len(r.history)} steps"
         )
