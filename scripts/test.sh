@@ -1,0 +1,7 @@
+#!/bin/bash
+# Full test pass: native build + pytest (parity with ref scripts/test.sh).
+set -ex
+
+cd "$(dirname "$0")/.."
+make -j -C native
+python -m pytest tests/ -q
