@@ -1,0 +1,26 @@
+"""Minimal single-tenant TPU tunnel probe.
+
+Claims the axon TPU in ONE process, runs a tiny matmul, and exits cleanly
+(never kill this process: a killed claimant wedges the tunnel for every
+later process — see round-1 postmortem in VERDICT.md).
+"""
+
+import sys
+import time
+
+t0 = time.time()
+print(f"[probe] importing jax...", flush=True)
+import jax
+
+print(f"[probe] jax {jax.__version__} imported at {time.time()-t0:.1f}s; "
+      "initializing devices...", flush=True)
+devs = jax.devices()
+print(f"[probe] devices at {time.time()-t0:.1f}s: {devs}", flush=True)
+import jax.numpy as jnp
+
+x = jnp.ones((1024, 1024), dtype=jnp.bfloat16)
+y = (x @ x).sum()
+jax.block_until_ready(y)
+print(f"[probe] matmul ok at {time.time()-t0:.1f}s: {float(y)}", flush=True)
+print(f"[probe] backend={jax.default_backend()} OK", flush=True)
+sys.exit(0)

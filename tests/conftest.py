@@ -11,20 +11,12 @@ factory before any backend is instantiated.
 """
 
 import os
+import sys
 
 os.environ.setdefault("JAX_ENABLE_X64", "0")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 
-import jax  # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-jax.config.update("jax_platforms", "cpu")
-try:
-    from jax._src import xla_bridge
+from __graft_entry__ import force_cpu_backend  # noqa: E402
 
-    xla_bridge._backend_factories.pop("axon", None)
-except Exception:  # pragma: no cover — jax internals moved; cpu config holds
-    pass
+force_cpu_backend(8)
