@@ -9,53 +9,66 @@ chip). Two measurements:
       averaging through the Manager (solo-quorum fast path), two-phase
       commit — i.e. BASELINE config-style DDP with one replica group.
 
+On a non-CPU backend the bench also A/B-tests the pallas flash-attention
+kernel against the XLA attention path and uses the faster one (after a
+numerics cross-check).
+
 Prints ONE JSON line: value = T1 (tokens/sec/chip with FT on),
 vs_baseline = T1/T0 (FT efficiency; the north-star demands >= 0.90 under
-chaos on a v5e-64 — here it is the single-chip FT overhead ratio).
+chaos on a v5e-64 — here it is the single-chip FT overhead ratio), plus
+``mfu`` = model FLOPs utilization of the FT loop against the chip's peak.
 """
 
 import json
 import os
 import subprocess
 import sys
-import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# TPU v5e bf16 peak per chip (BASELINE.md targets v5e-64).
+_TPU_PEAK_FLOPS = 197e12
+
+_PROBE_SNIPPET = r"""
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+jax.block_until_ready(x @ x)
+print("probe ok:", jax.default_backend())
+"""
+
 
 def _devices_or_fallback() -> None:
-    """Time-boxed accelerator init. The axon TPU tunnel is single-tenant
-    and a stale claim from a killed process can wedge jax.devices()
-    indefinitely; rather than hang the driver, fall back to a CPU run in a
-    clean subprocess (the JSON reports which backend actually measured)."""
+    """Time-boxed accelerator probe in a CHILD process. The axon TPU tunnel
+    is single-tenant and a stale claim from a killed process wedges backend
+    init indefinitely — and killing a claimant mid-claim is exactly what
+    creates the stale claim. So: probe in a subprocess; if it succeeds, the
+    main process initializes the (now proven healthy) backend itself; if it
+    hangs, LEAVE the child running (never kill it) and re-exec the bench on
+    CPU."""
     if os.environ.get("BENCH_NO_FALLBACK"):
         return
     budget = float(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
-    result = {}
-
-    def _probe() -> None:
-        try:
-            import jax
-
-            result["devices"] = jax.devices()
-        except Exception as e:  # noqa: BLE001
-            result["error"] = e
-
-    t = threading.Thread(target=_probe, daemon=True)
-    t.start()
-    t.join(budget)
-    if "devices" in result:
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _PROBE_SNIPPET],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        rc = proc.wait(timeout=budget)
+    except subprocess.TimeoutExpired:
+        rc = None  # hung in backend init — abandoned, NEVER killed
+    if rc == 0:
         return
-    if "error" in result:
+    if rc is None:
         sys.stderr.write(
-            f"bench: accelerator init failed ({result['error']!r}); "
-            "re-running on CPU\n"
+            f"bench: accelerator probe did not finish in {budget}s "
+            "(wedged tunnel?); re-running on CPU\n"
         )
     else:
         sys.stderr.write(
-            f"bench: accelerator init did not finish in {budget}s; "
-            "re-running on CPU\n"
+            f"bench: accelerator probe failed rc={rc}; re-running on CPU\n"
         )
     env = {
         k: v for k, v in os.environ.items()
@@ -64,19 +77,70 @@ def _devices_or_fallback() -> None:
     env["JAX_PLATFORMS"] = "cpu"
     env["BENCH_NO_FALLBACK"] = "1"
     env.setdefault("BENCH_MODEL", "tiny")  # CPU can't push 125m quickly
-    proc = subprocess.run(
+    out = subprocess.run(
         [sys.executable, os.path.abspath(__file__)],
         env=env,
         capture_output=True,
         text=True,
     )
-    sys.stdout.write(proc.stdout)
-    sys.stderr.write(proc.stderr)
-    # hard-exit (the stuck probe thread would keep the process alive) —
-    # but flush first: os._exit skips buffer flushing
+    sys.stdout.write(out.stdout)
+    sys.stderr.write(out.stderr)
     sys.stdout.flush()
     sys.stderr.flush()
-    os._exit(proc.returncode)
+    os._exit(out.returncode)
+
+
+def _flops_per_step(cfg, n_params: int, tokens_per_step: int) -> float:
+    """Analytic training FLOPs per step: 6*N per token (fwd+bwd matmuls)
+    plus the causal attention term 6*L*d_model*S per token (half of the
+    non-causal 12*L*d*S)."""
+    per_token = 6.0 * n_params + 6.0 * cfg.n_layers * cfg.d_model * cfg.max_seq_len
+    return per_token * tokens_per_step
+
+
+def _maybe_pick_flash(cfg, params, tokens, targets, tx):
+    """A/B the pallas flash kernel vs the XLA attention path on this
+    backend. Returns (attn_fn or None, label, speedup, max_err)."""
+    import jax
+    import numpy as np
+
+    from torchft_tpu.models import make_train_step, forward
+    from torchft_tpu.ops.flash import flash_attention
+
+    def flash_fn(q, k, v):
+        return flash_attention(q, k, v, causal=True)
+
+    try:
+        # numerics cross-check on logits first
+        logits_xla = forward(cfg, params, tokens)
+        logits_fl = forward(cfg, params, tokens, attn_fn=flash_fn)
+        err = float(
+            jax.numpy.max(jax.numpy.abs(logits_xla - logits_fl))
+        )
+        scale = float(jax.numpy.max(jax.numpy.abs(logits_xla))) + 1e-6
+        if err / scale > 5e-2:
+            return None, "xla", 1.0, err
+
+        def time_step(attn_fn):
+            step = make_train_step(cfg, tx, attn_fn=attn_fn, donate=False)
+            p, s = params, tx.init(params)
+            for _ in range(2):
+                p, s, loss = step(p, s, tokens, targets)
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            for _ in range(5):
+                p, s, loss = step(p, s, tokens, targets)
+            jax.block_until_ready(loss)
+            return time.perf_counter() - t0
+
+        t_xla = time_step(None)
+        t_flash = time_step(flash_fn)
+        if t_flash < t_xla:
+            return flash_fn, "flash", t_xla / t_flash, err
+        return None, "xla", t_xla / t_flash, err
+    except Exception as e:  # noqa: BLE001 — flash is an optimization only
+        sys.stderr.write(f"bench: flash A/B failed, using XLA path: {e}\n")
+        return None, "xla", 0.0, float("nan")
 
 
 def main() -> None:
@@ -96,6 +160,7 @@ def main() -> None:
         count_params,
         init_params,
         make_grad_step,
+        make_train_step,
     )
     from torchft_tpu.optim import OptimizerWrapper
 
@@ -106,6 +171,7 @@ def main() -> None:
 
     cfg = CONFIGS[model_name]
     tokens_per_step = batch * cfg.max_seq_len
+    backend = jax.default_backend()
 
     key = jax.random.key(0)
     params = init_params(cfg, key)
@@ -119,10 +185,16 @@ def main() -> None:
     )
     targets = jnp.roll(tokens, -1, axis=1)
 
-    # ---- T0: fault-free fused train step --------------------------------
-    from torchft_tpu.models import make_train_step
+    # ---- attention kernel selection ------------------------------------
+    if backend != "cpu":
+        attn_fn, attn_label, flash_speedup, flash_err = _maybe_pick_flash(
+            cfg, params, tokens, targets, tx
+        )
+    else:
+        attn_fn, attn_label, flash_speedup, flash_err = None, "xla", 0.0, 0.0
 
-    step_fused = make_train_step(cfg, tx, donate=True)
+    # ---- T0: fault-free fused train step --------------------------------
+    step_fused = make_train_step(cfg, tx, attn_fn=attn_fn, donate=True)
     p0, s0 = params, tx.init(params)
     for _ in range(warmup):
         p0, s0, loss = step_fused(p0, s0, tokens, targets)
@@ -157,7 +229,7 @@ def main() -> None:
     )
     ddp = DistributedDataParallel(manager)
     opt = OptimizerWrapper(manager, tx)
-    grad_step = make_grad_step(cfg)
+    grad_step = make_grad_step(cfg, attn_fn=attn_fn)
 
     committed = 0
     attempted = 0
@@ -193,6 +265,13 @@ def main() -> None:
     store.shutdown()
     lighthouse.shutdown()
 
+    flops_step = _flops_per_step(cfg, n_params, tokens_per_step)
+    if backend != "cpu":
+        mfu = flops_step * steps / t1_elapsed / _TPU_PEAK_FLOPS
+        mfu_ff = flops_step * steps / t0_elapsed / _TPU_PEAK_FLOPS
+    else:
+        mfu = mfu_ff = None  # no meaningful peak for the CPU fallback
+
     print(
         json.dumps(
             {
@@ -201,12 +280,22 @@ def main() -> None:
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(t1 / t0, 4),
                 "fault_free_tokens_per_sec": round(t0, 1),
+                "mfu": None if mfu is None else round(mfu, 4),
+                "mfu_fault_free": (
+                    None if mfu_ff is None else round(mfu_ff, 4)
+                ),
+                "flops_per_step": flops_step,
+                "attn": attn_label,
+                "flash_speedup": round(flash_speedup, 3),
+                "flash_max_err": (
+                    None if flash_err != flash_err else flash_err
+                ),
                 "commit_rate": committed / max(1, attempted),
                 "model": model_name,
                 "params_m": round(n_params / 1e6, 1),
                 "batch": batch,
                 "seq_len": cfg.max_seq_len,
-                "backend": jax.default_backend(),
+                "backend": backend,
             }
         )
     )

@@ -203,32 +203,109 @@ int connect_with_deadline(const std::string& host, int port,
   return fd;
 }
 
-ClientResult do_request(const std::string& method, const std::string& host,
-                        int port, const std::string& path,
-                        const std::string& body, int64_t deadline_ms) {
-  ClientResult result;
-  // Jittered exponential connect retry until deadline (ref src/retry.rs).
-  static thread_local std::mt19937 rng{std::random_device{}()};
-  int64_t backoff = 10;
-  int fd = -1;
-  std::string conn_err;
-  while (true) {
-    conn_err.clear();
-    fd = connect_with_deadline(host, port, deadline_ms, &conn_err);
-    if (fd >= 0) break;
-    int64_t remaining = deadline_ms - now_ms();
-    if (remaining <= 0) {
-      result.error = "connect deadline exceeded: " + conn_err;
-      result.timed_out = true;
-      return result;
-    }
-    std::uniform_int_distribution<int64_t> jitter(0, backoff / 2 + 1);
-    int64_t sleep_ms = std::min(backoff + jitter(rng), remaining);
-    std::this_thread::sleep_for(std::chrono::milliseconds(sleep_ms));
-    backoff = std::min<int64_t>(backoff * 2, 1000);
-  }
+void enable_tcp_keepalive(int fd) {
+  // Parity with the reference's HTTP2 keep-alives (src/net.rs:9-20:
+  // interval 60s, timeout 20s): detect dead peers on idle pooled
+  // connections at the TCP layer.
   int one = 1;
-  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+#ifdef TCP_KEEPIDLE
+  int idle = 60;
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &idle, sizeof(idle));
+#endif
+#ifdef TCP_KEEPINTVL
+  int intvl = 20;
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &intvl, sizeof(intvl));
+#endif
+#ifdef TCP_KEEPCNT
+  int cnt = 3;
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPCNT, &cnt, sizeof(cnt));
+#endif
+}
+
+// Idle-connection pool keyed by endpoint: heartbeats/quorum long-polls at a
+// 100 ms cadence must reuse one connection per (client, server) pair
+// instead of opening a socket per request (the role tonic's channel reuse
+// plays in the reference, src/net.rs).
+class ConnPool {
+ public:
+  static ConnPool& instance() {
+    static ConnPool* pool = new ConnPool();  // leaked: outlives all users
+    return *pool;
+  }
+
+  // Returns a pooled fd (reused=true) or -1 if none idle.
+  int acquire(const std::string& host, int port) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = idle_.find({host, port});
+    if (it == idle_.end() || it->second.empty()) return -1;
+    int fd = it->second.back();
+    it->second.pop_back();
+    --total_;
+    // Drop one matching lru_ entry so lru_.size() stays == total_
+    // (otherwise steady acquire/release cycles would grow it forever).
+    for (auto lit = lru_.begin(); lit != lru_.end(); ++lit) {
+      if (lit->first == host && lit->second == port) {
+        lru_.erase(lit);
+        break;
+      }
+    }
+    return fd;
+  }
+
+  void release(const std::string& host, int port, int fd) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto& v = idle_[{host, port}];
+    if (v.size() >= kMaxIdlePerEndpoint || total_ >= kMaxIdleTotal) {
+      // Global cap doubles as garbage collection: endpoints that went
+      // away (killed replicas on ephemeral ports) are evicted oldest-
+      // first instead of parking dead fds forever.
+      evict_oldest_locked();
+      if (v.size() >= kMaxIdlePerEndpoint) {
+        ::close(fd);
+        return;
+      }
+    }
+    v.push_back(fd);
+    lru_.push_back({host, port});
+    ++total_;
+  }
+
+ private:
+  static constexpr size_t kMaxIdlePerEndpoint = 4;
+  static constexpr size_t kMaxIdleTotal = 32;
+
+  void evict_oldest_locked() {
+    while (!lru_.empty()) {
+      auto key = lru_.front();
+      lru_.erase(lru_.begin());
+      auto it = idle_.find(key);
+      if (it == idle_.end() || it->second.empty()) continue;  // stale entry
+      ::close(it->second.front());
+      it->second.erase(it->second.begin());
+      --total_;
+      return;
+    }
+  }
+
+  std::mutex mu_;
+  std::map<std::pair<std::string, int>, std::vector<int>> idle_;
+  // Insertion-order endpoint keys, one entry per pooled fd (approximate
+  // LRU; stale entries are skipped during eviction).
+  std::vector<std::pair<std::string, int>> lru_;
+  size_t total_ = 0;
+};
+
+// One request/response exchange on an established connection. Returns
+// false with *retryable=true when the failure happened before any response
+// byte arrived on a REUSED connection (stale pooled socket: the server
+// closed it while idle) — the caller retries once on a fresh connection.
+bool exchange_once(int fd, const std::string& method, const std::string& host,
+                   int port, const std::string& path, const std::string& body,
+                   int64_t deadline_ms, bool reused, ClientResult* result,
+                   bool* retryable, bool* server_wants_close) {
+  *retryable = false;
+  *server_wants_close = false;
   int64_t remaining = deadline_ms - now_ms();
   if (remaining <= 0) remaining = 1;
   set_socket_timeout(fd, remaining + 1000);  // socket guard > logical deadline
@@ -239,62 +316,126 @@ ClientResult do_request(const std::string& method, const std::string& host,
      << "Content-Type: application/json\r\n"
      << "Content-Length: " << body.size() << "\r\n"
      << "x-timeout-ms: " << remaining << "\r\n"
-     << "Connection: close\r\n\r\n";
+     << "Connection: keep-alive\r\n\r\n";
   std::string head = ss.str();
   if (!send_all(fd, head.data(), head.size()) ||
       !send_all(fd, body.data(), body.size())) {
-    result.error = "send failed";
-    ::close(fd);
-    return result;
+    result->error = "send failed";
+    *retryable = reused;
+    return false;
   }
 
   ConnReader rd{fd};
   std::string status_line;
   if (!rd.read_line(&status_line)) {
-    result.error = "no response (recv failed or timed out)";
-    result.timed_out = (now_ms() >= deadline_ms);
-    ::close(fd);
-    return result;
+    result->error = "no response (recv failed or timed out)";
+    result->timed_out = (now_ms() >= deadline_ms);
+    // EOF with zero bytes on a reused conn = stale pooled socket; a
+    // timeout is a real deadline failure, never retried.
+    *retryable = reused && !result->timed_out;
+    return false;
   }
   // "HTTP/1.1 200 OK"
   {
     std::istringstream sl(status_line);
     std::string version;
-    sl >> version >> result.status;
+    sl >> version >> result->status;
   }
   size_t content_length = 0;
   while (true) {
     std::string h;
     if (!rd.read_line(&h)) {
-      result.error = "truncated headers";
-      ::close(fd);
-      return result;
+      result->error = "truncated headers";
+      return false;
     }
     if (h.empty()) break;
     size_t colon = h.find(':');
     if (colon == std::string::npos) continue;
-    if (lower(h.substr(0, colon)) == "content-length") {
+    std::string key = lower(h.substr(0, colon));
+    std::string val = h.substr(colon + 1);
+    while (!val.empty() && val.front() == ' ') val.erase(val.begin());
+    if (key == "content-length") {
       try {
-        long long v = std::stoll(h.substr(colon + 1));
+        long long v = std::stoll(val);
         if (v < 0) {
-          result.error = "bad content-length in response";
-          ::close(fd);
-          return result;
+          result->error = "bad content-length in response";
+          return false;
         }
         content_length = static_cast<size_t>(v);
       } catch (...) {
-        result.error = "bad content-length in response";
-        ::close(fd);
-        return result;
+        result->error = "bad content-length in response";
+        return false;
       }
+    } else if (key == "connection" && lower(val) == "close") {
+      *server_wants_close = true;
     }
   }
-  if (content_length > 0 && !rd.read_exact(content_length, &result.body)) {
-    result.error = "truncated body";
-    ::close(fd);
-    return result;
+  if (content_length > 0 && !rd.read_exact(content_length, &result->body)) {
+    result->error = "truncated body";
+    return false;
   }
-  ::close(fd);
+  // Anything the reader over-buffered past this response would desync the
+  // next request on this connection; don't pool it.
+  if (rd.pos != rd.buf.size()) *server_wants_close = true;
+  return true;
+}
+
+ClientResult do_request(const std::string& method, const std::string& host,
+                        int port, const std::string& path,
+                        const std::string& body, int64_t deadline_ms) {
+  ClientResult result;
+  auto& pool = ConnPool::instance();
+
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    result = ClientResult{};
+    bool reused = false;
+    int fd = -1;
+    if (attempt == 0) {
+      fd = pool.acquire(host, port);
+      reused = fd >= 0;
+    }
+    if (fd < 0) {
+      // Jittered exponential connect retry until deadline (ref
+      // src/retry.rs).
+      static thread_local std::mt19937 rng{std::random_device{}()};
+      int64_t backoff = 10;
+      std::string conn_err;
+      while (true) {
+        conn_err.clear();
+        fd = connect_with_deadline(host, port, deadline_ms, &conn_err);
+        if (fd >= 0) break;
+        int64_t remaining = deadline_ms - now_ms();
+        if (remaining <= 0) {
+          result.error = "connect deadline exceeded: " + conn_err;
+          result.timed_out = true;
+          return result;
+        }
+        std::uniform_int_distribution<int64_t> jitter(0, backoff / 2 + 1);
+        int64_t sleep_ms = std::min(backoff + jitter(rng), remaining);
+        std::this_thread::sleep_for(std::chrono::milliseconds(sleep_ms));
+        backoff = std::min<int64_t>(backoff * 2, 1000);
+      }
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      enable_tcp_keepalive(fd);
+    }
+
+    bool retryable = false;
+    bool server_wants_close = false;
+    bool ok = exchange_once(fd, method, host, port, path, body, deadline_ms,
+                            reused, &result, &retryable, &server_wants_close);
+    if (ok) {
+      if (server_wants_close) {
+        ::close(fd);
+      } else {
+        pool.release(host, port, fd);
+      }
+      return result;
+    }
+    ::close(fd);
+    if (!retryable) return result;
+    // stale pooled connection: one retry on a fresh socket
+  }
   return result;
 }
 
@@ -375,10 +516,15 @@ void HttpServer::accept_loop() {
     }
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    enable_tcp_keepalive(fd);
+    // Idle pooled client connections are parked in recv(); reap them if
+    // silent for 5 min so vanished clients can't leak server threads.
+    set_socket_timeout(fd, 300000);
     {
       std::lock_guard<std::mutex> lk(conn_mu_);
       conn_fds_.push_back(fd);
     }
+    total_accepted_.fetch_add(1);
     active_conns_.fetch_add(1);
     std::thread([this, fd] {
       serve_conn(fd);

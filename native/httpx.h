@@ -51,6 +51,10 @@ class HttpServer {
 
   int port() const { return port_; }
   const std::string& host() const { return host_; }
+  // Lifetime count of accepted connections: with client-side connection
+  // pooling this stays near the number of distinct clients instead of
+  // growing with every heartbeat (observability for keep-alive tests).
+  int total_accepted() const { return total_accepted_.load(); }
 
  private:
   void accept_loop();
@@ -63,6 +67,7 @@ class HttpServer {
   std::thread accept_thread_;
   std::atomic<bool> stopping_{false};
   std::atomic<int> active_conns_{0};
+  std::atomic<int> total_accepted_{0};
   std::mutex conn_mu_;
   std::vector<int> conn_fds_;
 };

@@ -132,6 +132,14 @@ Response Lighthouse::handle(const Request& req) {
   if (req.path == "/status" && req.method == "GET") {
     return handle_status();
   }
+  if (req.path == "/statsz" && req.method == "GET") {
+    // Transport-level stats (JSON): with client connection pooling the
+    // accepted count stays near the number of distinct clients instead of
+    // growing with every heartbeat (keep-alive parity, ref src/net.rs).
+    std::ostringstream js;
+    js << "{\"http_conns_accepted\":" << server_.total_accepted() << "}";
+    return Response{200, "application/json", js.str()};
+  }
   if (req.path == "/" && req.method == "GET") {
     // Dashboard shell: vanilla-JS 1s polling of /status (the reference uses
     // htmx for the same cadence, templates/index.html).

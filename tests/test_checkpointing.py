@@ -94,3 +94,116 @@ def test_large_state_roundtrip() -> None:
     for a, b in zip(big["params"], got["params"]):
         np.testing.assert_array_equal(a, b)
     server.shutdown()
+
+
+def test_chunked_recv_matches_full() -> None:
+    # num_chunks > 1: manifest + parallel per-leaf fetch must reassemble
+    # the identical pytree (incl. non-array leaves and a 0-d array).
+    state = {
+        "params": {
+            "w": np.arange(24, dtype=np.float32).reshape(4, 6),
+            "b": np.ones((6,), dtype=np.bfloat16)
+            if hasattr(np, "bfloat16")
+            else np.ones((6,), dtype=np.float16),
+        },
+        "scalars": {"count": np.float64(7.0), "step_arr": np.array(3)},
+        "torchft": {"step": 3, "batches_committed": 6},
+    }
+    donor = CheckpointServer(timeout=5.0)
+    donor.send_checkpoint([1], step=3, state_dict=state, timeout=5.0)
+    healer = CheckpointServer(timeout=5.0, num_chunks=4)
+    got = healer.recv_checkpoint(
+        src_rank=0, metadata=donor.metadata(), step=3, timeout=5.0
+    )
+    np.testing.assert_array_equal(got["params"]["w"], state["params"]["w"])
+    np.testing.assert_array_equal(got["params"]["b"], state["params"]["b"])
+    assert got["scalars"]["count"] == 7.0
+    assert got["scalars"]["step_arr"] == 3
+    assert got["torchft"] == {"step": 3, "batches_committed": 6}
+    donor.shutdown()
+    healer.shutdown()
+
+
+def test_leaf_fetch_with_slice() -> None:
+    # The sharded-heal building block: a healer pulls only its shard of a
+    # parameter; the slice happens donor-side so only shard bytes move.
+    from torchft_tpu.checkpointing import fetch_leaf, fetch_manifest
+
+    w = np.arange(64, dtype=np.float32).reshape(8, 8)
+    donor = CheckpointServer(timeout=5.0)
+    donor.send_checkpoint([1], step=5, state_dict={"w": w}, timeout=5.0)
+    manifest = fetch_manifest(donor.metadata(), 5)
+    assert [e["path"] for e in manifest["leaves"]] == ["['w']"]
+    assert manifest["leaves"][0]["shape"] == (8, 8)
+
+    shard = fetch_leaf(
+        donor.metadata(), 5, 0, slices=(slice(2, 6), slice(None))
+    )
+    np.testing.assert_array_equal(shard, w[2:6, :])
+    full = fetch_leaf(donor.metadata(), 5, 0)
+    np.testing.assert_array_equal(full, w)
+    donor.shutdown()
+
+
+def test_leaf_fetch_bad_slice_is_400() -> None:
+    donor = CheckpointServer(timeout=5.0)
+    donor.send_checkpoint(
+        [1], step=1,
+        state_dict={"w": np.zeros((4, 4), np.float32)}, timeout=5.0,
+    )
+    from torchft_tpu.checkpointing import fetch_leaf
+
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        fetch_leaf(donor.metadata(), 1, 0, slices=(slice(0, 99),))
+    assert exc_info.value.code == 400
+    donor.shutdown()
+
+
+def test_chunked_leaves_writable_and_int_avg_rejected() -> None:
+    # Chunked-healed leaves must be writable (in-place optimizer updates),
+    # and manager AVG must reject integer arrays instead of silently
+    # returning an unscaled sum.
+    state = {"w": np.arange(12, dtype=np.float32).reshape(3, 4)}
+    donor = CheckpointServer(timeout=5.0)
+    donor.send_checkpoint([1], step=2, state_dict=state, timeout=5.0)
+    healer = CheckpointServer(timeout=5.0, num_chunks=2)
+    got = healer.recv_checkpoint(0, donor.metadata(), 2, 5.0)
+    got["w"] += 1.0  # must not raise read-only
+    np.testing.assert_array_equal(got["w"], state["w"] + 1.0)
+
+    from torchft_tpu.checkpointing import fetch_leaf
+
+    leaf = fetch_leaf(donor.metadata(), 2, 0)
+    leaf += 1.0  # per-leaf fetch must also be writable
+    donor.shutdown()
+    healer.shutdown()
+
+
+def test_strided_slice_spec_rejected() -> None:
+    from torchft_tpu.checkpointing import format_slice_spec
+
+    with pytest.raises(ValueError, match="strided"):
+        format_slice_spec((slice(0, 8, 2),))
+
+
+def test_leaf_fetch_bfloat16() -> None:
+    # ml_dtypes arrays reject the buffer protocol; the leaf endpoint must
+    # serve their raw bytes correctly (regression: bf16 heal returned
+    # garbage with no error).
+    import ml_dtypes
+
+    from torchft_tpu.checkpointing import fetch_leaf
+
+    w = np.arange(16, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    donor = CheckpointServer(timeout=5.0)
+    donor.send_checkpoint([1], step=1, state_dict={"w": w}, timeout=5.0)
+    got = fetch_leaf(donor.metadata(), 1, 0)
+    assert got.dtype == w.dtype
+    np.testing.assert_array_equal(
+        got.astype(np.float32), w.astype(np.float32)
+    )
+    shard = fetch_leaf(donor.metadata(), 1, 0, slices=(slice(4, 8),))
+    np.testing.assert_array_equal(
+        shard.astype(np.float32), w[4:8].astype(np.float32)
+    )
+    donor.shutdown()

@@ -36,6 +36,7 @@ class FakeComm(CommContext):
     def __init__(self) -> None:
         super().__init__()
         self.configure_calls: List[tuple] = []
+        self.ops: List[str] = []
         self.fail_next: Optional[Exception] = None
         self.hang_next = False
 
@@ -44,6 +45,7 @@ class FakeComm(CommContext):
         self._rank, self._world_size = rank, world_size
 
     def allreduce(self, arrays, op=ReduceOp.SUM):
+        self.ops.append(op)
         if self.fail_next is not None:
             exc, self.fail_next = self.fail_next, None
             return FailedWork(exc)
@@ -245,6 +247,80 @@ def test_sync_quorum_heals_eagerly(store) -> None:
     manager.shutdown(wait=False)
 
 
+def test_avg_scales_by_participants_not_transport_world(store) -> None:
+    # AVG through the Manager must average over *participants*: the
+    # transport world also contains healing replicas that contribute
+    # zeros, so dividing by the transport world size (the raw transport
+    # AVG semantics) under-scales during a heal. The manager reduces as
+    # SUM and applies its own 1/num_participants, identical to SUM.
+    manager, client, comm, _ = make_manager(store)
+    client.quorum.return_value = quorum_result()  # 2 participants
+    client.should_commit.return_value = True
+    manager.start_quorum()
+    fut = manager.allreduce_arrays(
+        [np.full(3, 4.0, np.float32)], op=ReduceOp.AVG
+    ).future()
+    out = fut.result(timeout=5)
+    # identity-sum comm, 2 participants -> /2 (same as the SUM path)
+    np.testing.assert_allclose(out[0], np.full(3, 2.0))
+    # the transport must never see AVG from the manager
+    assert comm.ops == [ReduceOp.SUM]
+    manager.shutdown(wait=False)
+
+
+def test_healing_replica_avg_matches_sum_scaling(store) -> None:
+    # During a heal the local replica contributes zeros; AVG must still
+    # scale by the participant count (1 here), not the transport world.
+    donor_server = CheckpointServer(timeout=5.0)
+    donor_server.allow_checkpoint(
+        20,
+        {
+            "user": {"w": np.full(2, 7.0)},
+            "torchft": {"step": 20, "batches_committed": 40},
+        },
+    )
+    manager, client, comm, _ = make_manager(store)
+    client.quorum.return_value = quorum_result(
+        quorum_id=3,
+        replica_rank=1,
+        replica_world_size=2,
+        recover_src_rank=0,
+        recover_src_manager_address="http://donor:1",
+        max_step=20,
+        max_rank=None,
+        max_world_size=1,
+        heal=True,
+    )
+    client.should_commit.return_value = True
+    with patch("torchft_tpu.manager.ManagerClient") as heal_client_cls:
+        heal_client_cls.return_value.checkpoint_metadata.return_value = (
+            donor_server.address()
+        )
+        manager.start_quorum()
+        fut = manager.allreduce_arrays(
+            [np.full(2, 9.0, np.float32)], op=ReduceOp.AVG
+        ).future()
+        out = fut.result(timeout=5)
+    np.testing.assert_allclose(out[0], np.zeros(2))
+    assert comm.ops == [ReduceOp.SUM]
+    donor_server.shutdown()
+    manager.shutdown(wait=False)
+
+
+def test_max_not_scaled(store) -> None:
+    manager, client, comm, _ = make_manager(store)
+    client.quorum.return_value = quorum_result()
+    client.should_commit.return_value = True
+    manager.start_quorum()
+    fut = manager.allreduce_arrays(
+        [np.full(3, 4.0, np.float32)], op=ReduceOp.MAX
+    ).future()
+    out = fut.result(timeout=5)
+    np.testing.assert_allclose(out[0], np.full(3, 4.0))  # no 1/N scaling
+    assert comm.ops == [ReduceOp.MAX]
+    manager.shutdown(wait=False)
+
+
 def test_allreduce_error_latches_and_skips(store) -> None:
     manager, client, comm, _ = make_manager(store)
     client.quorum.return_value = quorum_result()
@@ -361,8 +437,8 @@ def test_donor_serves_recovering_peers(store) -> None:
     manager.start_quorum()
     manager.wait_quorum()
     transport = manager._checkpoint_transport
-    assert transport._staged_step == 7
-    staged = transport._staged_state
+    assert transport._staged.step == 7
+    staged = transport._staged.state
     assert staged["torchft"]["step"] == 0
     assert "w" in staged["user"]
     manager.shutdown(wait=False)
@@ -415,4 +491,15 @@ def test_shrink_only_plumbed_to_quorum(store) -> None:
     manager.start_quorum(shrink_only=True)
     manager.wait_quorum()
     assert client.quorum.call_args.kwargs["shrink_only"] is True
+    manager.shutdown(wait=False)
+
+
+def test_integer_avg_raises(store) -> None:
+    manager, client, comm, _ = make_manager(store)
+    client.quorum.return_value = quorum_result()
+    manager.start_quorum()
+    with pytest.raises(ValueError, match="AVG requires floating"):
+        manager.allreduce_arrays(
+            [np.array([4, 4], np.int64)], op=ReduceOp.AVG
+        )
     manager.shutdown(wait=False)

@@ -252,3 +252,48 @@ def test_sampler_padding_when_not_divisible() -> None:
     assert all(len(s) == 4 for s in shards)
     covered = set(i for s in shards for i in s)
     assert covered == set(range(10))
+
+
+def test_ddp_buckets_issue_pipelined() -> None:
+    # VERDICT item 3: bucket k+1 must be issued while bucket k is still in
+    # flight. With 3 buckets of 0.15s simulated transport latency each, a
+    # serialized issue loop would take >= 0.45s; the pipelined loop issues
+    # all buckets up front so wall clock stays near one latency.
+    import threading
+    import time
+    from concurrent.futures import Future
+
+    from torchft_tpu.comm.context import Work
+
+    delay = 0.15
+
+    def delayed_work(arrays, **kw):
+        fut: Future = Future()
+        fut.set_running_or_notify_cancel()
+        arrs = [np.array(a, copy=True) for a in arrays]
+
+        def _complete():
+            time.sleep(delay)
+            fut.set_result(arrs)
+
+        threading.Thread(target=_complete, daemon=True).start()
+        return Work(fut)
+
+    manager = mock_manager()
+    manager.allreduce_arrays.side_effect = delayed_work
+    ddp = DistributedDataParallel(manager, bucket_bytes=64)
+    grads = {
+        "a": jnp.arange(32, dtype=jnp.float32),
+        "b": jnp.ones(32, dtype=jnp.float32),
+        "c": jnp.ones(32, dtype=jnp.float64),
+    }
+    t0 = time.perf_counter()
+    out = ddp.average_gradients(grads)
+    elapsed = time.perf_counter() - t0
+    n_buckets = len(ddp._plan.buckets)
+    assert n_buckets >= 3
+    assert elapsed < n_buckets * delay * 0.75, (
+        f"buckets serialized: {elapsed:.3f}s with {n_buckets} buckets "
+        f"x {delay}s"
+    )
+    np.testing.assert_allclose(out["a"], grads["a"])

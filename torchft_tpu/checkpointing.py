@@ -16,13 +16,18 @@ and a donor can serve many healing peers from the same staged host copy.
 from __future__ import annotations
 
 import logging
+import pickle
 import socket
 import threading
 import urllib.request
 from abc import ABC, abstractmethod
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
 from datetime import timedelta
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Generic, List, Optional, TypeVar
+from typing import Any, Generic, List, Optional, Sequence, TypeVar
+
+import numpy as np
 
 from torchft_tpu.utils.serialization import pytree_from_stream, pytree_to_stream, to_host
 
@@ -30,7 +35,56 @@ logger = logging.getLogger(__name__)
 
 T = TypeVar("T")
 
-__all__ = ["CheckpointTransport", "CheckpointServer"]
+__all__ = [
+    "CheckpointTransport",
+    "CheckpointServer",
+    "fetch_manifest",
+    "fetch_leaf",
+    "format_slice_spec",
+]
+
+
+@dataclass(frozen=True)
+class _Staged:
+    """An immutable host copy of one staged checkpoint, pre-flattened so
+    leaf/manifest requests need no per-request tree work."""
+
+    step: int
+    state: Any
+    leaves: List[Any]
+    manifest_bytes: bytes
+    treedef: Any = field(repr=False, default=None)
+
+
+def _build_staged(step: int, state: Any) -> _Staged:
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    leaves = [leaf for _, leaf in flat]
+    entries = []
+    for keypath, leaf in flat:
+        if isinstance(leaf, np.ndarray):
+            entries.append(
+                {
+                    "path": jax.tree_util.keystr(keypath),
+                    "kind": "ndarray",
+                    "dtype": str(leaf.dtype),
+                    "shape": tuple(leaf.shape),
+                    "nbytes": int(leaf.nbytes),
+                }
+            )
+        else:
+            entries.append(
+                {"path": jax.tree_util.keystr(keypath), "kind": "object"}
+            )
+    manifest = {"step": step, "leaves": entries, "treedef": treedef}
+    return _Staged(
+        step=step,
+        state=state,
+        leaves=leaves,
+        manifest_bytes=pickle.dumps(manifest, protocol=5),
+        treedef=treedef,
+    )
 
 
 class CheckpointTransport(ABC, Generic[T]):
@@ -63,6 +117,44 @@ class CheckpointTransport(ABC, Generic[T]):
         """Tear down any serving resources."""
 
 
+def _parse_slice_spec(spec: str, shape: tuple) -> tuple:
+    """Parse "0:4,:,2:8" into a tuple of slices (one per dim, '' = full)."""
+    parts = spec.split(",")
+    if len(parts) != len(shape):
+        raise ValueError(
+            f"slice spec has {len(parts)} dims, array has {len(shape)}"
+        )
+    out = []
+    for p, dim in zip(parts, shape):
+        p = p.strip()
+        if p in ("", ":"):
+            out.append(slice(None))
+            continue
+        start_s, _, stop_s = p.partition(":")
+        start = int(start_s) if start_s else 0
+        stop = int(stop_s) if stop_s else dim
+        if not (0 <= start <= stop <= dim):
+            raise ValueError(f"slice {p} out of bounds for dim {dim}")
+        out.append(slice(start, stop))
+    return tuple(out)
+
+
+def format_slice_spec(slices: Sequence[slice]) -> str:
+    """Inverse of _parse_slice_spec (for building leaf shard URLs)."""
+    for s in slices:
+        if s.step not in (None, 1):
+            raise ValueError(
+                f"strided slices are not supported by the checkpoint "
+                f"plane (got step={s.step}); shard specs must be "
+                "contiguous start:stop ranges"
+            )
+    return ",".join(
+        f"{'' if s.start in (None, 0) else s.start}:"
+        f"{'' if s.stop is None else s.stop}"
+        for s in slices
+    )
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "torchft_tpu_ckpt"
@@ -70,22 +162,15 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, format: str, *args) -> None:  # noqa: A002
         logger.debug("checkpoint http: " + format, *args)
 
-    def do_GET(self) -> None:  # noqa: N802
+    def _await_staged(self, step: int) -> "Optional[_Staged]":
+        """Gate: block until the donor has staged a checkpoint. A healer's
+        fetch can land before the donor's send_checkpoint staged the state
+        (both sides act on the same quorum response concurrently), so the
+        gate must WAIT, not fail (ref checkpointing.py:139-170 holds a
+        lock while disallowed for the same reason). Returns the staged
+        bundle (an immutable host copy, safe to stream outside the gate),
+        or None after having sent an error response."""
         server: "CheckpointServer" = self.server.ckpt_server  # type: ignore[attr-defined]
-        prefix = "/checkpoint/"
-        if not self.path.startswith(prefix):
-            self.send_error(404, "unknown path")
-            return
-        try:
-            step = int(self.path[len(prefix):])
-        except ValueError:
-            self.send_error(400, "bad step")
-            return
-        # Gate: block until the donor has staged a checkpoint. A healer's
-        # fetch can land before the donor's send_checkpoint staged the state
-        # (both sides act on the same quorum response concurrently), so the
-        # gate must WAIT, not fail (ref checkpointing.py:139-170 holds a
-        # lock while disallowed for the same reason).
         with server._cond:
             opened = server._cond.wait_for(
                 lambda: not server._disallowed, timeout=server._timeout
@@ -95,29 +180,120 @@ class _Handler(BaseHTTPRequestHandler):
                     503,
                     f"timed out waiting for checkpoint gate for step {step}",
                 )
-                return
-            if server._staged_step != step:
+                return None
+            staged = server._staged
+            if staged is None or staged.step != step:
+                have = None if staged is None else staged.step
                 self.send_error(
                     400,
                     f"checkpoint for step {step} not available "
-                    f"(staged={server._staged_step})",
+                    f"(staged={have})",
                 )
-                return
-            # Pin a local ref: the staged object is a dedicated host copy
-            # (never mutated by training), so streaming can proceed outside
-            # the gate and disallow_checkpoint stays non-blocking.
-            staged = server._staged_state
+                return None
+            return staged
+
+    def do_GET(self) -> None:  # noqa: N802
+        from urllib.parse import parse_qs, urlparse
+
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        if not parts or parts[0] != "checkpoint":
+            self.send_error(404, "unknown path")
+            return
         try:
-            self.send_response(200)
-            self.send_header("Content-Type", "application/octet-stream")
-            # Chunked-free streaming: close delimits the body.
-            self.send_header("Connection", "close")
-            self.end_headers()
-            # staged is already an all-host copy (send_checkpoint converted)
-            pytree_to_stream(staged, self.wfile, convert=False)
+            step = int(parts[1])
+        except (IndexError, ValueError):
+            self.send_error(400, "bad step")
+            return
+        staged = self._await_staged(step)
+        if staged is None:
+            return
+
+        try:
+            if len(parts) == 2:  # /checkpoint/{step} — full pickle stream
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "application/octet-stream"
+                )
+                # Chunked-free streaming: close delimits the body.
+                self.send_header("Connection", "close")
+                self.end_headers()
+                # staged.state is already an all-host copy
+                pytree_to_stream(staged.state, self.wfile, convert=False)
+                self.close_connection = True
+                return
+
+            if parts[2] == "manifest":  # /checkpoint/{step}/manifest
+                body = staged.manifest_bytes
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "application/octet-stream"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+
+            if parts[2] == "leaves" and len(parts) == 4:
+                # /checkpoint/{step}/leaves/{lo}-{hi}: one pickled list of
+                # leaves[lo:hi] — lets a chunked receiver use exactly
+                # num_chunks connections instead of one per leaf.
+                lo_s, _, hi_s = parts[3].partition("-")
+                lo, hi = int(lo_s), int(hi_s)
+                if not (0 <= lo <= hi <= len(staged.leaves)):
+                    self.send_error(404, f"bad leaf range {lo}-{hi}")
+                    return
+                body = pickle.dumps(staged.leaves[lo:hi], protocol=5)
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+
+            if parts[2] == "leaf" and len(parts) == 4:
+                # /checkpoint/{step}/leaf/{i}[?slice=0:4,:,...]
+                # All slicing/serialization happens BEFORE headers are
+                # sent: a failure after send_response(200) could only
+                # corrupt the stream, not signal an error.
+                idx = int(parts[3])
+                if not (0 <= idx < len(staged.leaves)):
+                    self.send_error(404, f"no leaf {idx}")
+                    return
+                leaf = staged.leaves[idx]
+                if not isinstance(leaf, np.ndarray):
+                    body = pickle.dumps(leaf, protocol=5)
+                    self.send_response(200)
+                    self.send_header("X-Kind", "object")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                spec = parse_qs(url.query).get("slice", [None])[0]
+                if spec is not None:
+                    # Server-side shard slicing: only the healer's shard
+                    # bytes cross the wire (SURVEY.md §7 hard part 3).
+                    leaf = leaf[_parse_slice_spec(spec, leaf.shape)]
+                body_arr = np.ascontiguousarray(leaf)
+                # tobytes, not memoryview: ml_dtypes arrays (bfloat16,
+                # fp8) reject the buffer protocol's format codes.
+                body = body_arr.tobytes()
+                self.send_response(200)
+                self.send_header("X-Kind", "ndarray")
+                self.send_header("X-Dtype", str(body_arr.dtype))
+                self.send_header(
+                    "X-Shape",
+                    ",".join(str(d) for d in body_arr.shape),
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+
+            self.send_error(404, "unknown path")
+        except (ValueError, IndexError) as e:
+            self.send_error(400, str(e))
         except (BrokenPipeError, ConnectionResetError):
             logger.warning("checkpoint receiver disconnected mid-stream")
-        self.close_connection = True
 
 
 class CheckpointServer(CheckpointTransport[T]):
@@ -126,14 +302,16 @@ class CheckpointServer(CheckpointTransport[T]):
 
     def __init__(self, timeout: "float | timedelta" = 60.0,
                  num_chunks: int = 0) -> None:
+        """``num_chunks``: when > 1, recv_checkpoint fetches the donor's
+        leaves over that many parallel HTTP connections instead of one
+        pickle stream (ref checkpointing.py num_chunks)."""
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
         self._timeout = float(timeout)
+        self._num_chunks = int(num_chunks)
         self._cond = threading.Condition()
         self._disallowed = True
-        self._staged_step = -1
-        self._staged_state: Optional[object] = None
-        del num_chunks  # reserved: parallel chunked transfer
+        self._staged: Optional[_Staged] = None
 
         self._server = ThreadingHTTPServer(("0.0.0.0", 0), _Handler)
         self._server.daemon_threads = True
@@ -164,10 +342,9 @@ class CheckpointServer(CheckpointTransport[T]):
         # Stage a host copy NOW (device_get) so later training-step mutations
         # of device state can't tear the served bytes, then open the gate.
         del dst_ranks  # HTTP transport serves whoever fetches
-        staged = to_host(state_dict)
+        staged = _build_staged(step, to_host(state_dict))
         with self._cond:
-            self._staged_state = staged
-            self._staged_step = step
+            self._staged = staged
             self._disallowed = False
             self._cond.notify_all()
 
@@ -175,7 +352,7 @@ class CheckpointServer(CheckpointTransport[T]):
         with self._cond:
             if not self._disallowed:
                 self._disallowed = True
-                self._staged_state = None
+                self._staged = None
 
     def recv_checkpoint(
         self, src_rank: int, metadata: str, step: int,
@@ -184,6 +361,10 @@ class CheckpointServer(CheckpointTransport[T]):
         del src_rank
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
+        if self._num_chunks > 1:
+            return _recv_chunked(
+                metadata, step, self._num_chunks, float(timeout)
+            )
         url = f"{metadata}/checkpoint/{step}"
         logger.info("fetching checkpoint from %s", url)
         with urllib.request.urlopen(url, timeout=timeout) as resp:
@@ -202,3 +383,104 @@ class CheckpointServer(CheckpointTransport[T]):
 
     def address(self) -> str:
         return self._addr
+
+
+# ---------------------------------------------------------------- client side
+# Leaf-addressable fetch API. recv_checkpoint(num_chunks>1) uses it for
+# parallel transfer; the HSDP healer uses fetch_leaf with a slice spec to
+# stream only its own shard of each parameter (SURVEY.md §7 hard part 3).
+
+
+def _dtype_from_str(name: str) -> np.dtype:
+    """np.dtype from its str(), including ml_dtypes extension types
+    (bfloat16, float8_*) that numpy only resolves once registered."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def fetch_manifest(metadata: str, step: int, timeout: float = 60.0) -> dict:
+    """Fetch the donor's leaf manifest: {step, leaves: [{path, kind, dtype,
+    shape, nbytes}...], treedef}."""
+    url = f"{metadata}/checkpoint/{step}/manifest"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return pickle.load(resp)
+
+
+def fetch_leaf(
+    metadata: str,
+    step: int,
+    index: int,
+    slices: Optional[Sequence[slice]] = None,
+    timeout: float = 60.0,
+) -> Any:
+    """Fetch one leaf (optionally a server-sliced shard of it) by index."""
+    url = f"{metadata}/checkpoint/{step}/leaf/{index}"
+    if slices is not None:
+        url += "?slice=" + format_slice_spec(slices)
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        kind = resp.headers.get("X-Kind", "ndarray")
+        if kind == "object":
+            return pickle.loads(resp.read())
+        dtype = _dtype_from_str(resp.headers["X-Dtype"])
+        shape_hdr = resp.headers["X-Shape"]
+        shape = tuple(
+            int(d) for d in shape_hdr.split(",") if d
+        )
+        # Read into a mutable buffer: frombuffer over `bytes` would make
+        # the healed leaf read-only, breaking later in-place updates.
+        nbytes = int(resp.headers["Content-Length"])
+        buf = bytearray(nbytes)
+        view = memoryview(buf)
+        off = 0
+        while off < nbytes:
+            got = resp.readinto(view[off:])
+            if not got:
+                raise ConnectionError(
+                    f"leaf body truncated at {off}/{nbytes} bytes"
+                )
+            off += got
+        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+
+def _fetch_leaf_range(
+    metadata: str, step: int, lo: int, hi: int, timeout: float
+) -> List[Any]:
+    url = f"{metadata}/checkpoint/{step}/leaves/{lo}-{hi}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return pickle.load(resp)
+
+
+def _recv_chunked(
+    metadata: str, step: int, num_chunks: int, timeout: float
+) -> Any:
+    """Parallel transfer over exactly num_chunks connections: the leaf
+    index space is split into contiguous ranges, one request per range,
+    reassembled with the donor's treedef."""
+    import jax
+
+    manifest = fetch_manifest(metadata, step, timeout=timeout)
+    n = len(manifest["leaves"])
+    bounds = [
+        (n * k // num_chunks, n * (k + 1) // num_chunks)
+        for k in range(num_chunks)
+    ]
+    bounds = [(lo, hi) for lo, hi in bounds if hi > lo]
+    logger.info(
+        "fetching checkpoint step %d: %d leaves over %d connections",
+        step, n, len(bounds),
+    )
+    with ThreadPoolExecutor(max_workers=max(1, len(bounds))) as pool:
+        ranges = list(
+            pool.map(
+                lambda b: _fetch_leaf_range(
+                    metadata, step, b[0], b[1], timeout
+                ),
+                bounds,
+            )
+        )
+    leaves = [leaf for r in ranges for leaf in r]
+    return jax.tree_util.tree_unflatten(manifest["treedef"], leaves)

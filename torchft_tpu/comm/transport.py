@@ -11,12 +11,17 @@ socket transport that is rebuilt per quorum from the rendezvous store:
         payloads) and "ring" (bandwidth-optimal reduce-scatter +
         all-gather), selected per context ("auto" picks ring at >= 3).
 
-Every collective is queued onto one transport thread per context and
-processed strictly in issue order (the usual collective contract: all ranks
-issue identical op sequences). Reconfigure/shutdown closes sockets, which
-fails in-flight ops with ConnectionError — the abort analog for wedged
-transports (XLA collectives cannot be aborted; host sockets can,
-SURVEY.md §7 hard-part #2).
+Collectives are distributed over ``channels`` independent lanes — each
+lane owns its own socket set and worker thread, so several ops (e.g. DDP
+gradient buckets) are in flight on the wire at once and overlap with the
+backward pass that produces later buckets (the role of the reference's
+mid-backward comm hooks, ref ddp.py:49-71). Assignment is deterministic
+(submission index modulo lane count), so identical op sequences land on
+identical lanes on every rank and each lane's stream stays ordered.
+
+Reconfigure/shutdown closes sockets, which fails in-flight ops with
+ConnectionError — the abort analog for wedged transports (XLA collectives
+cannot be aborted; host sockets can, SURVEY.md §7 hard-part #2).
 """
 
 from __future__ import annotations
@@ -132,128 +137,57 @@ class _PendingOp:
         self.fut = fut
 
 
-class TcpCommContext(CommContext):
-    """Reconfigurable collective context over TCP (star or ring wire
-    topology; see class ctor)."""
+class _Lane:
+    """One independent connection set + worker thread. A context owns
+    ``channels`` lanes; every lane sees the same deterministic subsequence
+    of ops on every rank, so per-lane frame sequencing catches desyncs
+    exactly like the single-lane design did."""
 
-    def __init__(self, timeout: "float | timedelta" = 60.0,
-                 algorithm: str = "auto") -> None:
-        """``algorithm``: "star" (rank 0 reduces and fans out — lowest
-        latency for tiny payloads / few replicas), "ring" (bandwidth-optimal
-        reduce-scatter + all-gather: each link moves ~2B/n per allreduce
-        instead of the star root's 2B·(n-1)), or "auto" (ring for
-        world_size >= 3)."""
-        super().__init__()
-        if isinstance(timeout, timedelta):
-            timeout = timeout.total_seconds()
-        if algorithm not in ("auto", "star", "ring"):
-            raise ValueError(f"unknown algorithm {algorithm!r}")
-        self._algorithm = algorithm
-        self._use_ring = False
-        self._timeout = float(timeout)
-        self._generation = 0
-        self._lock = threading.Lock()
+    def __init__(self, ctx: "TcpCommContext", lane_id: int) -> None:
+        self._ctx = ctx
+        self._lane_id = lane_id
         self._queue: "queue.Queue[Optional[_PendingOp]]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
-        self._listener: Optional[socket.socket] = None
+        self._seq = 0
         self._peer_socks: Dict[int, socket.socket] = {}   # star: root only
         self._root_sock: Optional[socket.socket] = None   # star: non-root
         self._next_sock: Optional[socket.socket] = None   # ring
         self._prev_sock: Optional[socket.socket] = None   # ring
-        self._error: Optional[Exception] = None
-        self._seq = 0
 
-    # ------------------------------------------------------------ lifecycle
+    # Context-wide configuration, shared by every lane.
 
-    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
-        self.shutdown()
-        with self._lock:
-            self._generation += 1
-            self._rank = rank
-            self._world_size = world_size
-            self._error = None
-            self._seq = 0
-            self._queue = queue.Queue()
+    @property
+    def _rank(self) -> int:
+        return self._ctx._rank
 
-        if world_size == 1:
-            # Solo quorum: everything is an identity op, no sockets needed.
-            self._thread = threading.Thread(
-                target=self._run_loop, name="torchft_tpu_comm", daemon=True
-            )
-            self._thread.start()
-            return
+    @property
+    def _world_size(self) -> int:
+        return self._ctx._world_size
 
-        store = create_store_client(store_addr, timeout=self._timeout)
-        self._use_ring = self._algorithm == "ring" or (
-            self._algorithm == "auto" and world_size >= 3
-        )
-        if self._use_ring:
-            self._configure_ring(store, rank, world_size)
-            self._thread = threading.Thread(
-                target=self._run_loop, name="torchft_tpu_comm", daemon=True
-            )
-            self._thread.start()
-            return
-        if rank == 0:
-            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            listener.bind(("0.0.0.0", 0))
-            listener.listen(world_size)
-            listener.settimeout(self._timeout)
-            self._listener = listener
-            from torchft_tpu.utils.net import advertised_host
+    @property
+    def _timeout(self) -> float:
+        return self._ctx._timeout
 
-            store.set(
-                "comm_addr",
-                f"{advertised_host()}:{listener.getsockname()[1]}",
-            )
-            peers: Dict[int, socket.socket] = {}
-            try:
-                while len(peers) < world_size - 1:
-                    conn, _ = listener.accept()
-                    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    conn.settimeout(self._timeout)
-                    (peer_rank,) = struct.unpack("<I", _recv_exact(conn, 4))
-                    peers[peer_rank] = conn
-            except (OSError, socket.timeout) as e:
-                for s in peers.values():
-                    s.close()
-                listener.close()
-                raise TimeoutError(
-                    f"comm configure: rank 0 timed out waiting for "
-                    f"{world_size - 1} peers ({len(peers)} joined): {e}"
-                ) from e
-            self._peer_socks = peers
-        else:
-            addr = store.wait("comm_addr", timeout=self._timeout).decode()
-            host, port_s = addr.rsplit(":", 1)
-            sock = socket.create_connection(
-                (host, int(port_s)), timeout=self._timeout
-            )
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            sock.settimeout(self._timeout)
-            sock.sendall(struct.pack("<I", rank))
-            self._root_sock = sock
+    @property
+    def _use_ring(self) -> bool:
+        return self._ctx._use_ring
 
+    def start(self) -> None:
         self._thread = threading.Thread(
-            target=self._run_loop, name="torchft_tpu_comm", daemon=True
+            target=self._run_loop,
+            name=f"torchft_tpu_comm_l{self._lane_id}",
+            daemon=True,
         )
         self._thread.start()
 
-    def shutdown(self) -> None:
-        with self._lock:
-            thread = self._thread
-            self._thread = None
-            if thread is not None:
-                self._queue.put(None)  # sentinel; guarded so no op can be
-                # enqueued after it (see _submit)
+    def close_sockets(self) -> None:
         for s in list(self._peer_socks.values()):
             try:
                 s.close()
             except OSError:
                 pass
         self._peer_socks = {}
-        for attr in ("_next_sock", "_prev_sock"):
+        for attr in ("_next_sock", "_prev_sock", "_root_sock"):
             s = getattr(self, attr)
             if s is not None:
                 try:
@@ -261,61 +195,6 @@ class TcpCommContext(CommContext):
                 except OSError:
                     pass
                 setattr(self, attr, None)
-        if self._root_sock is not None:
-            try:
-                self._root_sock.close()
-            except OSError:
-                pass
-            self._root_sock = None
-        if self._listener is not None:
-            try:
-                self._listener.close()
-            except OSError:
-                pass
-            self._listener = None
-        if thread is not None:
-            thread.join(timeout=5.0)
-
-    def errored(self) -> Optional[Exception]:
-        with self._lock:
-            return self._error
-
-    # ----------------------------------------------------------- collectives
-
-    def _submit(self, opcode: int, arrays: Sequence[np.ndarray], op: str,
-                root: int) -> Work:
-        fut: Future = Future()
-        fut.set_running_or_notify_cancel()
-        err = self.errored()
-        if err is not None:
-            fut.set_exception(
-                ConnectionError(f"comm context previously errored: {err}")
-            )
-            return Work(fut)
-        pending = _PendingOp(
-            opcode, [np.asarray(a) for a in arrays], op, root, fut
-        )
-        # Lock pairs with shutdown(): either we enqueue before the sentinel
-        # (op will be drained) or we observe _thread is None and fail fast.
-        with self._lock:
-            if self._thread is None:
-                fut.set_exception(
-                    RuntimeError("comm context not configured")
-                )
-                return Work(fut)
-            self._queue.put(pending)
-        return Work(fut)
-
-    def allreduce(
-        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
-    ) -> Work:
-        return self._submit(_OP_ALLREDUCE, arrays, op, 0)
-
-    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
-        return self._submit(_OP_ALLGATHER, arrays, ReduceOp.SUM, 0)
-
-    def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
-        return self._submit(_OP_BROADCAST, arrays, ReduceOp.SUM, root)
 
     # ------------------------------------------------------ transport thread
 
@@ -328,12 +207,10 @@ class TcpCommContext(CommContext):
                 result = self._execute(pending)
                 pending.fut.set_result(result)
             except Exception as e:  # noqa: BLE001 — latch every transport error
-                with self._lock:
-                    if self._error is None:
-                        self._error = e
+                self._ctx._latch_error(e)
                 logger.warning(
-                    "comm op failed (rank %d world %d): %s",
-                    self._rank, self._world_size, e,
+                    "comm op failed (rank %d world %d lane %d): %s",
+                    self._rank, self._world_size, self._lane_id, e,
                 )
                 try:
                     pending.fut.set_exception(e)
@@ -342,6 +219,12 @@ class TcpCommContext(CommContext):
 
     def _execute(self, p: _PendingOp):
         self._seq += 1
+        delay = self._ctx._op_delay
+        if delay:
+            # Test hook: simulated per-op wire latency (overlap tests).
+            import time as _time
+
+            _time.sleep(delay)
         if self._world_size == 1:
             if p.opcode == _OP_ALLGATHER:
                 return [p.arrays]
@@ -429,55 +312,6 @@ class TcpCommContext(CommContext):
         return result
 
     # ---------------------------------------------------------- ring variant
-
-    def _configure_ring(self, store, rank: int, world_size: int) -> None:
-        """Ring rendezvous: every rank publishes a listener; rank r dials
-        (r+1) % n and accepts one connection from (r-1) % n."""
-        from torchft_tpu.utils.net import advertised_host
-
-        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        listener.bind(("0.0.0.0", 0))
-        listener.listen(4)
-        listener.settimeout(self._timeout)
-        self._listener = listener
-        store.set(
-            f"ring_addr_{rank}",
-            f"{advertised_host()}:{listener.getsockname()[1]}",
-        )
-
-        next_rank = (rank + 1) % world_size
-        addr = store.wait(
-            f"ring_addr_{next_rank}", timeout=self._timeout
-        ).decode()
-        host, port_s = addr.rsplit(":", 1)
-        try:
-            next_sock = socket.create_connection(
-                (host, int(port_s)), timeout=self._timeout
-            )
-            next_sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            next_sock.settimeout(self._timeout)
-            next_sock.sendall(struct.pack("<I", rank))
-            prev_sock, _ = listener.accept()
-            prev_sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            prev_sock.settimeout(self._timeout)
-            (prev_rank,) = struct.unpack("<I", _recv_exact(prev_sock, 4))
-        except (OSError, socket.timeout) as e:
-            listener.close()
-            raise TimeoutError(
-                f"ring configure: rank {rank} could not link the ring: {e}"
-            ) from e
-        expected_prev = (rank - 1) % world_size
-        if prev_rank != expected_prev:
-            prev_sock.close()
-            next_sock.close()
-            listener.close()
-            raise ConnectionError(
-                f"ring configure: rank {rank} accepted rank {prev_rank}, "
-                f"expected {expected_prev} (stale round?)"
-            )
-        self._next_sock = next_sock
-        self._prev_sock = prev_sock
 
     _RING_HDR = struct.Struct("<BQHQ")  # opcode, seq, step, payload bytes
 
@@ -640,3 +474,290 @@ class TcpCommContext(CommContext):
             for f in flats:
                 np.divide(f, n, out=f)
         return out
+
+
+class TcpCommContext(CommContext):
+    """Reconfigurable collective context over TCP (star or ring wire
+    topology; see class ctor)."""
+
+    def __init__(self, timeout: "float | timedelta" = 60.0,
+                 algorithm: str = "auto", channels: int = 4) -> None:
+        """``algorithm``: "star" (rank 0 reduces and fans out — lowest
+        latency for tiny payloads / few replicas), "ring" (bandwidth-optimal
+        reduce-scatter + all-gather: each link moves ~2B/n per allreduce
+        instead of the star root's 2B·(n-1)), or "auto" (ring for
+        world_size >= 3).
+
+        ``channels``: number of independent socket lanes; ops are assigned
+        round-robin by submission index, so up to ``channels`` collectives
+        progress on the wire concurrently (backward/comm overlap for DDP
+        buckets). Must match across ranks."""
+        super().__init__()
+        if isinstance(timeout, timedelta):
+            timeout = timeout.total_seconds()
+        if algorithm not in ("auto", "star", "ring"):
+            raise ValueError(f"unknown algorithm {algorithm!r}")
+        if channels < 1:
+            raise ValueError("channels must be >= 1")
+        self._algorithm = algorithm
+        self._channels = int(channels)
+        self._use_ring = False
+        self._timeout = float(timeout)
+        self._generation = 0
+        self._lock = threading.Lock()
+        self._lanes: List[_Lane] = []
+        self._rr = 0
+        self._listener: Optional[socket.socket] = None
+        self._error: Optional[Exception] = None
+        self._op_delay = 0.0  # test hook: simulated per-op wire latency
+
+    # ------------------------------------------------------------ lifecycle
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self.shutdown()
+        with self._lock:
+            self._generation += 1
+            self._rank = rank
+            self._world_size = world_size
+            self._error = None
+            self._rr = 0
+
+        n_lanes = 1 if world_size == 1 else self._channels
+        lanes = [_Lane(self, i) for i in range(n_lanes)]
+
+        if world_size == 1:
+            # Solo quorum: everything is an identity op, no sockets needed.
+            self._install_lanes(lanes)
+            return
+
+        store = create_store_client(store_addr, timeout=self._timeout)
+        self._use_ring = self._algorithm == "ring" or (
+            self._algorithm == "auto" and world_size >= 3
+        )
+        if self._use_ring:
+            self._configure_ring(store, rank, world_size, lanes)
+        else:
+            self._configure_star(store, rank, world_size, lanes)
+        self._install_lanes(lanes)
+
+    def _install_lanes(self, lanes: List[_Lane]) -> None:
+        for lane in lanes:
+            lane.start()
+        with self._lock:
+            self._lanes = lanes
+
+    def _configure_star(
+        self, store, rank: int, world_size: int, lanes: List[_Lane]
+    ) -> None:
+        """Star rendezvous: rank 0 listens; every peer dials one connection
+        per lane, tagged [rank u32][lane u32]."""
+        n_lanes = len(lanes)
+        if rank == 0:
+            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            listener.bind(("0.0.0.0", 0))
+            listener.listen(world_size * n_lanes)
+            listener.settimeout(self._timeout)
+            self._listener = listener
+            from torchft_tpu.utils.net import advertised_host
+
+            store.set(
+                "comm_addr",
+                f"{advertised_host()}:{listener.getsockname()[1]}",
+            )
+            expected = (world_size - 1) * n_lanes
+            accepted = 0
+            try:
+                while accepted < expected:
+                    conn, _ = listener.accept()
+                    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    conn.settimeout(self._timeout)
+                    peer_rank, lane_id = struct.unpack(
+                        "<II", _recv_exact(conn, 8)
+                    )
+                    if lane_id >= n_lanes:
+                        conn.close()  # belongs to no lane; close directly
+                        raise ConnectionError(
+                            f"peer {peer_rank} sent lane {lane_id}, have "
+                            f"{n_lanes} lanes (channels mismatch across "
+                            "ranks?)"
+                        )
+                    lane_socks = lanes[lane_id]._peer_socks
+                    if peer_rank in lane_socks:
+                        # redial (crash-restart inside the configure
+                        # window): newest connection wins, count unchanged
+                        lane_socks[peer_rank].close()
+                        lane_socks[peer_rank] = conn
+                    else:
+                        lane_socks[peer_rank] = conn
+                        accepted += 1
+            except (OSError, socket.timeout, ConnectionError) as e:
+                for lane in lanes:
+                    lane.close_sockets()
+                listener.close()
+                self._listener = None
+                raise TimeoutError(
+                    f"comm configure: rank 0 failed waiting for "
+                    f"{expected} lane connections ({accepted} joined): {e}"
+                ) from e
+        else:
+            addr = store.wait("comm_addr", timeout=self._timeout).decode()
+            host, port_s = addr.rsplit(":", 1)
+            try:
+                for lane in lanes:
+                    sock = socket.create_connection(
+                        (host, int(port_s)), timeout=self._timeout
+                    )
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    sock.settimeout(self._timeout)
+                    sock.sendall(struct.pack("<II", rank, lane._lane_id))
+                    lane._root_sock = sock
+            except OSError as e:
+                for lane in lanes:
+                    lane.close_sockets()
+                raise TimeoutError(
+                    f"comm configure: rank {rank} could not reach root: {e}"
+                ) from e
+
+    def _configure_ring(
+        self, store, rank: int, world_size: int, lanes: List[_Lane]
+    ) -> None:
+        """Ring rendezvous: every rank publishes a listener; rank r dials
+        (r+1) % n once per lane and accepts one connection per lane from
+        (r-1) % n, matched by the [rank u32][lane u32] tag."""
+        from torchft_tpu.utils.net import advertised_host
+
+        n_lanes = len(lanes)
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("0.0.0.0", 0))
+        listener.listen(2 * n_lanes)
+        listener.settimeout(self._timeout)
+        self._listener = listener
+        store.set(
+            f"ring_addr_{rank}",
+            f"{advertised_host()}:{listener.getsockname()[1]}",
+        )
+
+        next_rank = (rank + 1) % world_size
+        expected_prev = (rank - 1) % world_size
+        addr = store.wait(
+            f"ring_addr_{next_rank}", timeout=self._timeout
+        ).decode()
+        host, port_s = addr.rsplit(":", 1)
+        try:
+            for lane in lanes:
+                next_sock = socket.create_connection(
+                    (host, int(port_s)), timeout=self._timeout
+                )
+                next_sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+                next_sock.settimeout(self._timeout)
+                next_sock.sendall(
+                    struct.pack("<II", rank, lane._lane_id)
+                )
+                lane._next_sock = next_sock
+            accepted = 0
+            while accepted < n_lanes:
+                prev_sock, _ = listener.accept()
+                prev_sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+                prev_sock.settimeout(self._timeout)
+                prev_rank, lane_id = struct.unpack(
+                    "<II", _recv_exact(prev_sock, 8)
+                )
+                if prev_rank != expected_prev:
+                    prev_sock.close()  # belongs to no lane; close directly
+                    raise ConnectionError(
+                        f"ring configure: rank {rank} accepted rank "
+                        f"{prev_rank}, expected {expected_prev} (stale "
+                        "round?)"
+                    )
+                if lane_id >= n_lanes or lanes[lane_id]._prev_sock is not None:
+                    prev_sock.close()
+                    raise ConnectionError(
+                        f"ring configure: bad/duplicate lane {lane_id} "
+                        "(channels mismatch across ranks?)"
+                    )
+                lanes[lane_id]._prev_sock = prev_sock
+                accepted += 1
+        except (OSError, socket.timeout, ConnectionError) as e:
+            for lane in lanes:
+                lane.close_sockets()
+            listener.close()
+            self._listener = None
+            if isinstance(e, ConnectionError):
+                raise
+            raise TimeoutError(
+                f"ring configure: rank {rank} could not link the ring: {e}"
+            ) from e
+
+    def shutdown(self) -> None:
+        with self._lock:
+            lanes = self._lanes
+            self._lanes = []
+            for lane in lanes:
+                lane._queue.put(None)  # sentinel; guarded so no op can be
+                # enqueued after it (see _submit)
+        for lane in lanes:
+            lane.close_sockets()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        for lane in lanes:
+            if lane._thread is not None:
+                lane._thread.join(timeout=5.0)
+                lane._thread = None
+
+    def errored(self) -> Optional[Exception]:
+        with self._lock:
+            return self._error
+
+    def _latch_error(self, e: Exception) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = e
+
+    # ----------------------------------------------------------- collectives
+
+    def _submit(self, opcode: int, arrays: Sequence[np.ndarray], op: str,
+                root: int) -> Work:
+        fut: Future = Future()
+        fut.set_running_or_notify_cancel()
+        err = self.errored()
+        if err is not None:
+            fut.set_exception(
+                ConnectionError(f"comm context previously errored: {err}")
+            )
+            return Work(fut)
+        pending = _PendingOp(
+            opcode, [np.asarray(a) for a in arrays], op, root, fut
+        )
+        # Lock pairs with shutdown(): either we enqueue before the sentinel
+        # (op will be drained) or we observe no lanes and fail fast.
+        with self._lock:
+            if not self._lanes:
+                fut.set_exception(
+                    RuntimeError("comm context not configured")
+                )
+                return Work(fut)
+            lane = self._lanes[self._rr % len(self._lanes)]
+            self._rr += 1
+            lane._queue.put(pending)
+        return Work(fut)
+
+    def allreduce(
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
+    ) -> Work:
+        return self._submit(_OP_ALLREDUCE, arrays, op, 0)
+
+    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
+        return self._submit(_OP_ALLGATHER, arrays, ReduceOp.SUM, 0)
+
+    def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
+        return self._submit(_OP_BROADCAST, arrays, ReduceOp.SUM, root)

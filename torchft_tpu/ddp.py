@@ -30,12 +30,16 @@ _DEFAULT_BUCKET_BYTES = 32 * 1024 * 1024
 
 
 class _BucketPlan:
-    """Fixed mapping of flat leaf indices into dtype-homogeneous buckets."""
+    """Fixed mapping of flat leaf indices into dtype-homogeneous buckets.
 
-    def __init__(self, leaves: Sequence[np.ndarray], bucket_bytes: int) -> None:
-        self.shapes = [l.shape for l in leaves]
-        self.dtypes = [l.dtype for l in leaves]
-        self.sizes = [int(l.size) for l in leaves]
+    Built from leaf shapes/dtypes only (works on device arrays without
+    fetching them) so bucket k's device→host copy and transport submit can
+    happen before bucket k+1's gradients have even landed on host."""
+
+    def __init__(self, leaves: Sequence[Any], bucket_bytes: int) -> None:
+        self.shapes = [tuple(l.shape) for l in leaves]
+        self.dtypes = [np.dtype(l.dtype) for l in leaves]
+        self.sizes = [int(np.prod(s, dtype=np.int64)) for s in self.shapes]
         # Group leaf indices by dtype, then chunk by byte budget. Tree
         # order within a dtype is preserved — deterministic across replicas.
         by_dtype: Dict[str, List[int]] = {}
@@ -60,16 +64,12 @@ class _BucketPlan:
     def signature(self) -> Tuple:
         return tuple(zip(self.shapes, [d.str for d in self.dtypes]))
 
-    def pack(self, leaves: Sequence[np.ndarray]) -> List[np.ndarray]:
-        out = []
-        for bucket in self.buckets:
-            if len(bucket) == 1:
-                out.append(np.ascontiguousarray(leaves[bucket[0]]).ravel())
-            else:
-                out.append(
-                    np.concatenate([leaves[i].ravel() for i in bucket])
-                )
-        return out
+    @staticmethod
+    def pack_bucket(bucket_leaves: Sequence[np.ndarray]) -> np.ndarray:
+        """Flatten one bucket's (already-host) leaves, in plan order."""
+        if len(bucket_leaves) == 1:
+            return np.ascontiguousarray(bucket_leaves[0]).ravel()
+        return np.concatenate([l.ravel() for l in bucket_leaves])
 
     def unpack(self, flat_buckets: Sequence[np.ndarray]) -> List[np.ndarray]:
         leaves: List[np.ndarray] = [None] * len(self.shapes)  # type: ignore[list-item]
@@ -100,7 +100,8 @@ class DistributedDataParallel:
                 self._plan = _BucketPlan(host_leaves, self._bucket_bytes)
             else:
                 fresh = tuple(
-                    (l.shape, l.dtype.str) for l in host_leaves
+                    (tuple(l.shape), np.dtype(l.dtype).str)
+                    for l in host_leaves
                 )
                 if fresh != self._plan.signature():
                     raise ValueError(
@@ -149,13 +150,18 @@ class DistributedDataParallel:
         for l in leaves:
             if hasattr(l, "copy_to_host_async"):
                 l.copy_to_host_async()
-        host = [np.asarray(jax.device_get(l)) for l in leaves]
-        plan = self._get_plan(host)
-        buckets = plan.pack(host)
+        # Plan from shapes/dtypes alone — no host fetch yet.
+        plan = self._get_plan(leaves)
 
-        # One manager allreduce per bucket, all in flight at once — the
-        # transport pipelines them; each is individually error-latched.
-        works = [self._manager.allreduce_arrays([b]) for b in buckets]
+        # Pipelined per-bucket issue (the mid-backward comm-hook analog,
+        # ref ddp.py:49-71): block only on bucket k's leaves, submit its
+        # transport op, then move to bucket k+1 — so bucket k rides the
+        # wire (on its own transport lane) while later host copies land.
+        works = []
+        for bucket in plan.buckets:
+            host_b = [np.asarray(jax.device_get(leaves[i])) for i in bucket]
+            packed = plan.pack_bucket(host_b)
+            works.append(self._manager.allreduce_arrays([packed]))
 
         def _finish(_f) -> Any:
             reduced = []
@@ -168,7 +174,11 @@ class DistributedDataParallel:
             ]
             return jax.tree_util.tree_unflatten(treedef, device_leaves)
 
-        return future_chain(works[-1].future(), _finish)
+        from torchft_tpu.futures import future_all
+
+        return future_chain(
+            future_all([w.future() for w in works]), _finish
+        )
 
 
 class PureDistributedDataParallel:

@@ -28,6 +28,7 @@ __all__ = [
     "future_timeout",
     "future_wait",
     "future_chain",
+    "future_all",
     "completed_future",
     "failed_future",
     "TimerHandle",
@@ -159,6 +160,32 @@ def future_chain(fut: "Future[T]", fn: "Callable[[Future[T]], S]") -> "Future[S]
             _try_set_exception(out, e)
 
     fut.add_done_callback(_done)
+    return out
+
+
+def future_all(futs: "list[Future]") -> "Future[list[Future]]":
+    """Completes with the input futures once ALL of them are done —
+    successfully or not (the caller inspects each; errors are typically
+    already latched by wrap_future). Non-blocking barrier for fan-out ops
+    like DDP's per-bucket allreduces, which can finish out of order when
+    the transport runs multiple lanes."""
+    out: Future = Future()
+    out.set_running_or_notify_cancel()
+    if not futs:
+        out.set_result([])
+        return out
+    remaining = [len(futs)]
+    lock = threading.Lock()
+
+    def _done(_f: Future) -> None:
+        with lock:
+            remaining[0] -= 1
+            if remaining[0] != 0:
+                return
+        out.set_result(list(futs))
+
+    for f in futs:
+        f.add_done_callback(_done)
     return out
 
 

@@ -72,14 +72,16 @@ class LocalSGD:
         self._save_backup(params)
         return params
 
-    def __enter__(self) -> "LocalSGD":
-        return self
-
-    def __exit__(self, exc_type, exc_value, traceback) -> bool:
-        # Exceptions roll the caller back to the last synced state via
-        # restore() (ref local_sgd.py:104-119); params are caller-owned in
-        # JAX so we only expose the restore point.
-        return False
+    # NOTE: no context-manager protocol. The torch reference restores the
+    # model in place on __exit__ (ref local_sgd.py:104-119); params here are
+    # caller-owned JAX values, so an __exit__ could not reach them — callers
+    # roll back explicitly with restore() instead:
+    #
+    #     try:
+    #         params, opt_state = inner_step(...)
+    #         params = local.step(params)
+    #     except Exception:
+    #         params = local.restore()
 
     def _save_backup(self, params: Any) -> None:
         self._backup = _to_host_copy(params)

@@ -54,6 +54,12 @@ logger = logging.getLogger(__name__)
 
 T = TypeVar("T")
 
+
+def _is_float_dtype(dt: np.dtype) -> bool:
+    """True for numpy floats AND ml_dtypes extension floats (bfloat16,
+    float8_*), which np.issubdtype does not classify under np.floating."""
+    return np.issubdtype(dt, np.floating) or "float" in np.dtype(dt).name
+
 MANAGER_ADDR_KEY: str = "manager_addr"
 REPLICA_ID_KEY: str = "replica_id"
 MANAGER_PORT_ENV: str = "TORCHFT_TPU_MANAGER_PORT"
@@ -212,6 +218,16 @@ class Manager:
           completes (with the corrupt-but-unused input as the default)
         """
         arrays = [np.asarray(a) for a in arrays]
+        if op == ReduceOp.AVG and any(
+            not _is_float_dtype(a.dtype) for a in arrays
+        ):
+            # A caller bug, not a transport fault: _normalize's 1/N scaling
+            # only applies to floating leaves, so integer AVG would
+            # silently return the unscaled SUM.
+            raise ValueError(
+                "ReduceOp.AVG requires floating-point arrays; got "
+                + str([str(a.dtype) for a in arrays])
+            )
         if self.errored() is not None:
             return CompletedWork(list(arrays))
 
@@ -231,21 +247,25 @@ class Manager:
             import time as _time
 
             submit_time = _time.perf_counter()
-            work = self._comm.allreduce(arrays, op)
+            # AVG must average over *participants*, not the transport world
+            # (healing replicas are transport members but contribute zeros).
+            # Reduce as SUM and apply the participant scaling below — the
+            # same 1/num_participants the SUM path uses (ref manager.py:287).
+            transport_op = ReduceOp.SUM if op == ReduceOp.AVG else op
+            work = self._comm.allreduce(arrays, transport_op)
 
             def _normalize(f: Future) -> List[np.ndarray]:
                 self.metrics.observe(
                     "allreduce", _time.perf_counter() - submit_time
                 )
                 reduced = f.result()  # raises into wrap future on error
-                if op != ReduceOp.SUM:
-                    # AVG is already divided by the transport; MAX/MIN must
-                    # not be scaled at all.
+                if op not in (ReduceOp.SUM, ReduceOp.AVG):
+                    # MAX/MIN must not be scaled at all.
                     return reduced
                 scale = 1.0 / max(1, self.num_participants())
                 return [
                     (a * np.asarray(scale).astype(a.dtype))
-                    if np.issubdtype(a.dtype, np.floating)
+                    if _is_float_dtype(a.dtype)
                     else a
                     for a in reduced
                 ]
