@@ -242,38 +242,46 @@ class ConnPool {
     int fd = it->second.back();
     it->second.pop_back();
     --total_;
-    // Drop one matching lru_ entry so lru_.size() stays == total_
-    // (otherwise steady acquire/release cycles would grow it forever).
-    for (auto lit = lru_.begin(); lit != lru_.end(); ++lit) {
-      if (lit->first == host && lit->second == port) {
-        lru_.erase(lit);
-        break;
-      }
-    }
+    // Keep lru_.size() == total_ (otherwise steady acquire/release
+    // cycles would grow it forever).
+    drop_one_lru_entry_locked({host, port});
     return fd;
   }
 
   void release(const std::string& host, int port, int fd) {
     std::lock_guard<std::mutex> lk(mu_);
-    auto& v = idle_[{host, port}];
-    if (v.size() >= kMaxIdlePerEndpoint || total_ >= kMaxIdleTotal) {
+    auto key = std::make_pair(host, port);
+    auto& v = idle_[key];
+    if (v.size() >= kMaxIdlePerEndpoint) {
+      // Per-endpoint cap: retire THIS endpoint's oldest fd for the fresh
+      // one (never punish another endpoint's healthy connection).
+      ::close(v.front());
+      v.erase(v.begin());
+      drop_one_lru_entry_locked(key);
+      --total_;
+    } else if (total_ >= kMaxIdleTotal) {
       // Global cap doubles as garbage collection: endpoints that went
       // away (killed replicas on ephemeral ports) are evicted oldest-
       // first instead of parking dead fds forever.
       evict_oldest_locked();
-      if (v.size() >= kMaxIdlePerEndpoint) {
-        ::close(fd);
-        return;
-      }
     }
     v.push_back(fd);
-    lru_.push_back({host, port});
+    lru_.push_back(key);
     ++total_;
   }
 
  private:
   static constexpr size_t kMaxIdlePerEndpoint = 4;
   static constexpr size_t kMaxIdleTotal = 32;
+
+  void drop_one_lru_entry_locked(const std::pair<std::string, int>& key) {
+    for (auto lit = lru_.begin(); lit != lru_.end(); ++lit) {
+      if (*lit == key) {
+        lru_.erase(lit);
+        return;
+      }
+    }
+  }
 
   void evict_oldest_locked() {
     while (!lru_.empty()) {

@@ -41,6 +41,7 @@ __all__ = [
     "fetch_manifest",
     "fetch_leaf",
     "format_slice_spec",
+    "recv_checkpoint_sharded",
 ]
 
 
@@ -301,14 +302,26 @@ class CheckpointServer(CheckpointTransport[T]):
     (ref checkpointing.py:110-270)."""
 
     def __init__(self, timeout: "float | timedelta" = 60.0,
-                 num_chunks: int = 0) -> None:
+                 num_chunks: int = 0,
+                 template_fn: "Optional[Any]" = None) -> None:
         """``num_chunks``: when > 1, recv_checkpoint fetches the donor's
         leaves over that many parallel HTTP connections instead of one
-        pickle stream (ref checkpointing.py num_chunks)."""
+        pickle stream (ref checkpointing.py num_chunks).
+
+        ``template_fn``: zero-arg callable returning the healer's CURRENT
+        state dict (same pytree structure the donor serves). When set,
+        recv_checkpoint performs a SHARDING-AWARE fetch: for every leaf
+        whose template counterpart is a sharded jax.Array, only the local
+        shard slices are requested (sliced donor-side, so just shard bytes
+        cross DCN) and the healed leaf is assembled directly onto the
+        healer's devices with its existing sharding — the HSDP heal path
+        (SURVEY.md §7 hard part 3; fixes the device_get-assembled-arrays
+        limitation flagged in round 1)."""
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
         self._timeout = float(timeout)
         self._num_chunks = int(num_chunks)
+        self._template_fn = template_fn
         self._cond = threading.Condition()
         self._disallowed = True
         self._staged: Optional[_Staged] = None
@@ -361,6 +374,11 @@ class CheckpointServer(CheckpointTransport[T]):
         del src_rank
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
+        if self._template_fn is not None:
+            return recv_checkpoint_sharded(
+                metadata, step, self._template_fn(), float(timeout),
+                parallel=max(2, self._num_chunks),
+            )
         if self._num_chunks > 1:
             return _recv_chunked(
                 metadata, step, self._num_chunks, float(timeout)
@@ -444,6 +462,113 @@ def fetch_leaf(
                 )
             off += got
         return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+
+def _normalize_index(index, shape) -> "tuple[tuple[int, int], ...]":
+    """Shard index (tuple of slices from a jax sharding) as hashable
+    (start, stop) pairs with concrete bounds for every dim (slice objects
+    themselves are unhashable before Python 3.12)."""
+    out = []
+    for s, dim in zip(index, shape):
+        start = 0 if s.start is None else int(s.start)
+        stop = dim if s.stop is None else int(s.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _bounds_to_slices(bounds) -> "tuple[slice, ...]":
+    return tuple(slice(a, b) for a, b in bounds)
+
+
+def recv_checkpoint_sharded(
+    metadata: str,
+    step: int,
+    template: Any,
+    timeout: float = 60.0,
+    parallel: int = 4,
+) -> Any:
+    """Sharding-aware heal fetch: for each leaf whose ``template``
+    counterpart is a jax.Array, fetch only the slices this process's
+    devices hold (donor slices server-side) and assemble the result with
+    the template's sharding via make_array_from_callback. Other leaves are
+    fetched whole. The donor and healer must run the same model — leaf
+    paths are cross-checked against the donor's manifest."""
+    import jax
+
+    manifest = fetch_manifest(metadata, step, timeout=timeout)
+    entries = manifest["leaves"]
+    t_flat, t_def = jax.tree_util.tree_flatten_with_path(template)
+    if len(t_flat) != len(entries):
+        raise ValueError(
+            f"template has {len(t_flat)} leaves, donor checkpoint has "
+            f"{len(entries)} — model structure mismatch"
+        )
+    for (kp, _), entry in zip(t_flat, entries):
+        path = jax.tree_util.keystr(kp)
+        if path != entry["path"]:
+            raise ValueError(
+                f"leaf path mismatch: template {path!r} vs donor "
+                f"{entry['path']!r}"
+            )
+
+    # Plan all fetches first (unique shard slices per leaf), pull them in
+    # parallel, then assemble on-device.
+    plans = []  # (leaf_index, entry, tleaf, {norm_index: None-or-bytes})
+    for i, ((kp, tleaf), entry) in enumerate(zip(t_flat, entries)):
+        if entry["kind"] == "ndarray" and isinstance(tleaf, jax.Array):
+            shape = tuple(entry["shape"])
+            if tuple(tleaf.shape) != shape:
+                raise ValueError(
+                    f"shape mismatch at {entry['path']}: template "
+                    f"{tuple(tleaf.shape)} vs donor {shape}"
+                )
+            idx_map = tleaf.sharding.addressable_devices_indices_map(shape)
+            unique = {_normalize_index(ix, shape): None
+                      for ix in idx_map.values()}
+            plans.append((i, entry, tleaf, unique))
+        else:
+            plans.append((i, entry, tleaf, None))
+
+    def _fetch(job):
+        i, bounds = job
+        if bounds is None:
+            return fetch_leaf(metadata, step, i, timeout=timeout)
+        return fetch_leaf(
+            metadata, step, i, slices=_bounds_to_slices(bounds),
+            timeout=timeout,
+        )
+
+    jobs = []
+    for i, entry, tleaf, unique in plans:
+        if unique is None:
+            jobs.append((i, None))
+        else:
+            jobs.extend((i, ix) for ix in unique)
+    with ThreadPoolExecutor(max_workers=max(1, parallel)) as pool:
+        fetched = list(pool.map(_fetch, jobs))
+
+    results_by_job = dict(zip(jobs, fetched))
+    leaves = []
+    for i, entry, tleaf, unique in plans:
+        if unique is None:
+            leaves.append(results_by_job[(i, None)])
+            continue
+        dtype = tleaf.dtype
+        shards = {
+            ix: np.asarray(results_by_job[(i, ix)]).astype(
+                dtype, copy=False
+            )
+            for ix in unique
+        }
+        shape = tuple(entry["shape"])
+
+        def _cb(index, _shards=shards, _shape=shape):
+            return _shards[_normalize_index(index, _shape)]
+
+        leaves.append(
+            jax.make_array_from_callback(shape, tleaf.sharding, _cb)
+        )
+    return jax.tree_util.tree_unflatten(t_def, leaves)
 
 
 def _fetch_leaf_range(
