@@ -111,3 +111,128 @@ def test_microbatch_helpers() -> None:
     assert mb.shape == (3, 4, 2)
     np.testing.assert_array_equal(np.asarray(merge_microbatches(mb)),
                                   np.asarray(x))
+
+
+# ----------------------------------------------------------------- schedules
+
+
+def test_schedule_properties() -> None:
+    from torchft_tpu.parallel import (
+        bubble_fraction,
+        gpipe_schedule,
+        interleaved_1f1b_schedule,
+        one_f_one_b_schedule,
+        peak_inflight_activations,
+        validate_schedule,
+    )
+
+    S, M = 4, 16
+    g = gpipe_schedule(S, M)
+    o = one_f_one_b_schedule(S, M)
+    validate_schedule(g, S, M)
+    validate_schedule(o, S, M)
+    # same makespan/bubble; 1F1B bounds in-flight activations by S not M
+    assert len(g) == len(o)
+    assert abs(bubble_fraction(g) - bubble_fraction(o)) < 1e-9
+    assert peak_inflight_activations(g) == M
+    assert peak_inflight_activations(o) == S
+    # interleaved 1F1B: bubble measurably below GPipe's (VERDICT item 9)
+    iv = interleaved_1f1b_schedule(S, M, interleave=2)
+    validate_schedule(iv, S, M, interleave=2)
+    assert bubble_fraction(iv) < bubble_fraction(g) - 0.02, (
+        bubble_fraction(iv), bubble_fraction(g)
+    )
+
+
+def test_pipeline_embed_readout_heterogeneous_shapes() -> None:
+    # round-1 restriction lifted: int32 token ids in, logits out, hidden
+    # [mb, d] flowing between stages
+    from torchft_tpu.parallel import (
+        ft_mesh, make_pipeline, split_microbatches, stack_stage_params,
+    )
+
+    S, vocab, d = 4, 11, 8
+    mesh = ft_mesh({"stage": S}, devices=jax.devices()[:S])
+    rng = np.random.default_rng(0)
+    emb = jnp.asarray(rng.standard_normal((vocab, d)), jnp.float32) * 0.3
+    head = jnp.asarray(rng.standard_normal((d, vocab)), jnp.float32) * 0.3
+    stage_params = [
+        {"w": jnp.asarray(rng.standard_normal((d, d)), jnp.float32) * 0.3}
+        for _ in range(S)
+    ]
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    pp = make_pipeline(
+        mesh, stage_fn,
+        embed_fn=lambda tok: emb[tok],
+        readout_fn=lambda h: h @ head,
+    )
+    tokens = jnp.asarray(rng.integers(0, vocab, (8,)), jnp.int32)
+    mb = split_microbatches(tokens, 4)  # [4, 2] int32
+    out = jax.jit(pp)(stack_stage_params(stage_params), mb)
+    assert out.shape == (4, 2, vocab)
+
+    # sequential reference
+    h = emb[tokens]
+    for p in stage_params:
+        h = stage_fn(p, h)
+    ref = h @ head
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(8, vocab), np.asarray(ref),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_pipeline_1f1b_matches_sequential_grads() -> None:
+    from torchft_tpu.parallel import (
+        ft_mesh, make_pipeline_1f1b, split_microbatches, stack_stage_params,
+    )
+
+    S, M, mb_size, d = 4, 8, 2, 6
+    mesh = ft_mesh({"stage": S}, devices=jax.devices()[:S])
+    rng = np.random.default_rng(1)
+    stage_params = [
+        {"w": jnp.asarray(rng.standard_normal((d, d)), jnp.float32) * 0.4}
+        for _ in range(S)
+    ]
+    x = jnp.asarray(rng.standard_normal((M * mb_size, d)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((M * mb_size, d)), jnp.float32)
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def loss_fn(h, y_mb):
+        return jnp.mean((h - y_mb) ** 2)
+
+    pp = make_pipeline_1f1b(mesh, stage_fn, loss_fn, num_microbatches=M)
+    stacked = stack_stage_params(stage_params)
+    loss, grads = jax.jit(pp)(
+        stacked, split_microbatches(x, M), split_microbatches(y, M)
+    )
+
+    # sequential reference: mean over microbatch losses
+    def ref_loss(stacked_p):
+        params = [
+            jax.tree_util.tree_map(lambda l: l[i], stacked_p)
+            for i in range(S)
+        ]
+        total = 0.0
+        xm = split_microbatches(x, M)
+        ym = split_microbatches(y, M)
+        for k in range(M):
+            h = xm[k]
+            for p in params:
+                h = stage_fn(p, h)
+            total = total + loss_fn(h, ym[k])
+        return total / M
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(stacked)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        grads, ref_g,
+    )

@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 from torchft_tpu.comm.store import StoreServer
+from torchft_tpu.comm.context import ReduceOp
 from torchft_tpu.comm.subproc import SubprocessCommContext
 
 
@@ -94,3 +95,31 @@ def test_subproc_child_death_surfaces_error(store) -> None:
         assert ctx.errored() is not None
     finally:
         ctx.shutdown()
+
+
+def test_subprocess_compression_plumbed(store) -> None:
+    # The compression/channels/algorithm options must reach the child's
+    # transport (they were previously unreachable through this wrapper).
+    from concurrent.futures import ThreadPoolExecutor
+
+    ctxs = [
+        SubprocessCommContext(timeout=15.0, compression="bf16")
+        for _ in range(2)
+    ]
+    results = [None, None]
+
+    def _worker(rank):
+        ctxs[rank].configure(f"{store.addr}/subc", rank, 2)
+        work = ctxs[rank].allreduce(
+            [np.full(8, float(rank + 1), np.float32)], ReduceOp.SUM
+        )
+        results[rank] = work.future().result(timeout=20)[0]
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        for f in [pool.submit(_worker, r) for r in range(2)]:
+            f.result(timeout=40)
+    for ctx in ctxs:
+        ctx.shutdown()
+    for out in results:
+        np.testing.assert_allclose(out, np.full(8, 3.0), rtol=1e-2)
+    np.testing.assert_array_equal(results[0], results[1])

@@ -43,12 +43,13 @@ _CMD_CONFIGURE = "configure"
 _CMD_OP = "op"
 
 
-def _child_main(tx: "mp.Queue", rx: "mp.Queue", timeout: float) -> None:
+def _child_main(tx: "mp.Queue", rx: "mp.Queue", timeout: float,
+                transport_kwargs: Optional[dict] = None) -> None:
     """Child process: own a TcpCommContext, execute commands in order
     (the worker-loop role of ref process_group.py:727-834)."""
     from torchft_tpu.comm.transport import TcpCommContext
 
-    ctx = TcpCommContext(timeout=timeout)
+    ctx = TcpCommContext(timeout=timeout, **(transport_kwargs or {}))
     try:
         while True:
             cmd = tx.get()
@@ -89,7 +90,8 @@ class _PendingCall:
 class _Epoch:
     """One child-process generation and everything scoped to it."""
 
-    def __init__(self, mp_ctx, timeout: float) -> None:
+    def __init__(self, mp_ctx, timeout: float,
+                 transport_kwargs: Optional[dict] = None) -> None:
         self.tx: "mp.Queue" = mp_ctx.Queue()
         self.rx: "mp.Queue" = mp_ctx.Queue()
         self.calls: "queue_mod.Queue[Optional[_PendingCall]]" = (
@@ -98,7 +100,7 @@ class _Epoch:
         self.timeout = timeout
         self.proc: mp.Process = mp_ctx.Process(
             target=_child_main,
-            args=(self.tx, self.rx, timeout),
+            args=(self.tx, self.rx, timeout, transport_kwargs),
             daemon=True,
             name="torchft_tpu_comm_child",
         )
@@ -153,11 +155,20 @@ class _Epoch:
 class SubprocessCommContext(CommContext):
     """CommContext façade over a killable child process."""
 
-    def __init__(self, timeout: "float | timedelta" = 60.0) -> None:
+    def __init__(self, timeout: "float | timedelta" = 60.0,
+                 algorithm: str = "auto", channels: int = 4,
+                 compression: str = "none") -> None:
+        """``algorithm``/``channels``/``compression`` are forwarded to the
+        child's TcpCommContext (see transport.py for their semantics)."""
         super().__init__()
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
         self._timeout = float(timeout)
+        self._transport_kwargs = {
+            "algorithm": algorithm,
+            "channels": channels,
+            "compression": compression,
+        }
         self._mp = mp.get_context("spawn")
         self._epoch: Optional[_Epoch] = None
         self._lock = threading.Lock()
@@ -177,7 +188,8 @@ class SubprocessCommContext(CommContext):
         self._rank = rank
         self._world_size = world_size
 
-        epoch = _Epoch(self._mp, self._timeout)
+        epoch = _Epoch(self._mp, self._timeout,
+                       self._transport_kwargs)
         epoch.proc.start()
         epoch.tx.put((_CMD_CONFIGURE, store_addr, rank, world_size))
         try:

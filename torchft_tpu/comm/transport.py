@@ -70,12 +70,33 @@ def _send_arrays(sock: socket.socket, arrays: Sequence[np.ndarray]) -> None:
     sock.sendall(_pack_arrays(arrays))
 
 
+def _dtype_tag(d: np.dtype) -> bytes:
+    """Wire tag that round-trips extension dtypes: ml_dtypes types
+    (bfloat16, float8_*) stringify to an anonymous '<V2', so use the
+    registered name for them instead."""
+    if d.str.lstrip("<>|=").startswith("V"):
+        return d.name.encode()
+    return d.str.encode()
+
+
+def _dtype_from_tag(tag: str) -> np.dtype:
+    try:
+        d = np.dtype(tag)
+        if not d.str.lstrip("<>|=").startswith("V"):
+            return d
+    except TypeError:
+        pass
+    import ml_dtypes
+
+    return np.dtype(getattr(ml_dtypes, tag))
+
+
 def _pack_arrays(arrays: Sequence[np.ndarray]) -> bytes:
     """In-memory version of _send_arrays' framing."""
     parts = [struct.pack("<I", len(arrays))]
     for a in arrays:
         a = np.ascontiguousarray(a)
-        dt = a.dtype.str.encode()
+        dt = _dtype_tag(a.dtype)
         parts.append(struct.pack("<H", len(dt)))
         parts.append(dt)
         parts.append(struct.pack("<B", a.ndim))
@@ -101,7 +122,7 @@ def _unpack_arrays(data: bytes) -> List[np.ndarray]:
     out: List[np.ndarray] = []
     for _ in range(count):
         (dlen,) = struct.unpack("<H", take(2))
-        dtype = np.dtype(take(dlen).decode())
+        dtype = _dtype_from_tag(take(dlen).decode())
         (ndim,) = struct.unpack("<B", take(1))
         shape = struct.unpack(f"<{ndim}q", take(8 * ndim)) if ndim else ()
         (nbytes,) = struct.unpack("<Q", take(8))
@@ -118,7 +139,7 @@ def _recv_arrays(sock: socket.socket) -> List[np.ndarray]:
     out: List[np.ndarray] = []
     for _ in range(n):
         (dlen,) = struct.unpack("<H", _recv_exact(sock, 2))
-        dtype = np.dtype(_recv_exact(sock, dlen).decode())
+        dtype = _dtype_from_tag(_recv_exact(sock, dlen).decode())
         (ndim,) = struct.unpack("<B", _recv_exact(sock, 1))
         shape = struct.unpack(f"<{ndim}q", _recv_exact(sock, 8 * ndim)) if ndim else ()
         (nbytes,) = struct.unpack("<Q", _recv_exact(sock, 8))
@@ -135,6 +156,191 @@ class _PendingOp:
         self.op = op
         self.root = root
         self.fut = fut
+
+
+# --------------------------------------------------------------- compression
+# Wire codecs for ALLREDUCE payloads (gradients). DCN bandwidth is the
+# north-star bottleneck under chaos; bf16 halves the bytes per gradient
+# element, int8 quarters them (per-array absmax scale). Reduction still
+# accumulates in the caller's dtype (f32), and fan-out/all-gather phases
+# forward the SAME encoded bytes to every rank, so all replicas decode
+# identical values — the bitwise trajectory-consistency invariant holds.
+# allgather/broadcast carry state (checkpoint-adjacent), never compressed.
+
+
+def _is_compressible(a: np.ndarray) -> bool:
+    return a.dtype in (np.float32, np.float64)
+
+
+class _NoCodec:
+    name = "none"
+
+    def encode_arrays(self, arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+        return list(arrays)
+
+    def decode_arrays(
+        self, wire: List[np.ndarray], ref: Sequence[np.ndarray]
+    ) -> List[np.ndarray]:
+        return list(wire)
+
+    # chunk-view (ring) interface
+    def wire_nbytes(self, v: np.ndarray) -> int:
+        return v.nbytes
+
+    def encode_views(self, views: Sequence[np.ndarray]) -> bytes:
+        return b"".join(v.tobytes() for v in views)
+
+    def decode_into(self, data: bytes, views: Sequence[np.ndarray],
+                    combine) -> None:
+        offset = 0
+        for v in views:
+            nb = v.nbytes
+            incoming = np.frombuffer(data[offset: offset + nb], dtype=v.dtype)
+            combine(v, incoming)
+            offset += nb
+
+
+class _AstypeCodec(_NoCodec):
+    """Lossy float downcast on the wire (bf16 / fp16); non-float arrays
+    pass through untouched."""
+
+    def __init__(self, name: str, wire_dtype) -> None:
+        self.name = name
+        self._wd = np.dtype(wire_dtype)
+
+    def encode_arrays(self, arrays):
+        return [
+            a.astype(self._wd) if _is_compressible(a) else a for a in arrays
+        ]
+
+    def decode_arrays(self, wire, ref):
+        return [
+            w.astype(r.dtype) if _is_compressible(r) else w
+            for w, r in zip(wire, ref)
+        ]
+
+    def wire_nbytes(self, v: np.ndarray) -> int:
+        if _is_compressible(v):
+            return v.size * self._wd.itemsize
+        return v.nbytes
+
+    def encode_views(self, views):
+        return b"".join(
+            (v.astype(self._wd) if _is_compressible(v) else v).tobytes()
+            for v in views
+        )
+
+    def decode_into(self, data, views, combine):
+        offset = 0
+        for v in views:
+            if _is_compressible(v):
+                nb = v.size * self._wd.itemsize
+                incoming = np.frombuffer(
+                    data[offset: offset + nb], dtype=self._wd
+                ).astype(v.dtype)
+            else:
+                nb = v.nbytes
+                incoming = np.frombuffer(
+                    data[offset: offset + nb], dtype=v.dtype
+                )
+            combine(v, incoming)
+            offset += nb
+
+
+class _Int8Codec(_NoCodec):
+    """Per-array (per-chunk on the ring) absmax int8 quantization: wire =
+    [scale f32][int8 payload]. Max abs error per element is scale/2 =
+    absmax/254."""
+
+    name = "int8"
+
+    @staticmethod
+    def _quantize(a: np.ndarray) -> "tuple[np.float32, np.ndarray]":
+        absmax = float(np.max(np.abs(a))) if a.size else 0.0
+        if not np.isfinite(absmax):
+            # Poison the whole array with a NaN scale rather than
+            # silently clipping Inf/NaN into plausible int8 values — the
+            # decode yields NaN everywhere, so downstream grad-norm/NaN
+            # checks fire exactly as they would uncompressed. Wire size
+            # stays deterministic (ring peers expect exact lengths).
+            return np.float32("nan"), np.zeros(a.shape, np.int8)
+        scale = np.float32(absmax / 127.0 if absmax > 0 else 1.0)
+        q = np.clip(np.rint(a / scale), -127, 127).astype(np.int8)
+        return scale, q
+
+    def encode_arrays(self, arrays):
+        out: List[np.ndarray] = []
+        for a in arrays:
+            if _is_compressible(a):
+                scale, q = self._quantize(a)
+                out.append(np.asarray(scale))
+                out.append(q)
+            else:
+                out.append(a)
+        return out
+
+    def decode_arrays(self, wire, ref):
+        out: List[np.ndarray] = []
+        i = 0
+        for r in ref:
+            if _is_compressible(r):
+                scale = np.float32(wire[i])
+                q = wire[i + 1]
+                out.append((q.astype(r.dtype)) * r.dtype.type(scale))
+                i += 2
+            else:
+                out.append(wire[i])
+                i += 1
+        return out
+
+    def wire_nbytes(self, v: np.ndarray) -> int:
+        if _is_compressible(v):
+            return 4 + v.size
+        return v.nbytes
+
+    def encode_views(self, views):
+        parts = []
+        for v in views:
+            if _is_compressible(v):
+                scale, q = self._quantize(v)
+                parts.append(np.float32(scale).tobytes())
+                parts.append(q.tobytes())
+            else:
+                parts.append(v.tobytes())
+        return b"".join(parts)
+
+    def decode_into(self, data, views, combine):
+        offset = 0
+        for v in views:
+            if _is_compressible(v):
+                scale = np.frombuffer(
+                    data[offset: offset + 4], dtype=np.float32
+                )[0]
+                q = np.frombuffer(
+                    data[offset + 4: offset + 4 + v.size], dtype=np.int8
+                )
+                incoming = q.astype(v.dtype) * v.dtype.type(scale)
+                offset += 4 + v.size
+            else:
+                incoming = np.frombuffer(
+                    data[offset: offset + v.nbytes], dtype=v.dtype
+                )
+                offset += v.nbytes
+            combine(v, incoming)
+
+
+_CODECS = {
+    "none": _NoCodec,
+    "bf16": lambda: _AstypeCodec("bf16", _bf16_dtype()),
+    "fp16": lambda: _AstypeCodec("fp16", np.float16),
+    "int8": _Int8Codec,
+}
+
+
+def _bf16_dtype():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
 
 
 class _Lane:
@@ -171,6 +377,10 @@ class _Lane:
     @property
     def _use_ring(self) -> bool:
         return self._ctx._use_ring
+
+    @property
+    def _codec(self):
+        return self._ctx._codec
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -238,6 +448,7 @@ class _Lane:
         return self._execute_peer(p)
 
     def _execute_root(self, p: _PendingOp):
+        codec = self._codec
         contributions: Dict[int, List[np.ndarray]] = {0: p.arrays}
         for peer_rank, sock in sorted(self._peer_socks.items()):
             opcode, seq, _op = struct.unpack("<BQB", _recv_exact(sock, 10))
@@ -247,7 +458,10 @@ class _Lane:
                     f"got op={opcode} seq={seq}, expected op={p.opcode} "
                     f"seq={self._seq}"
                 )
-            contributions[peer_rank] = _recv_arrays(sock)
+            wire = _recv_arrays(sock)
+            if p.opcode == _OP_ALLREDUCE:
+                wire = codec.decode_arrays(wire, p.arrays)
+            contributions[peer_rank] = wire
 
         if p.opcode == _OP_ALLREDUCE:
             reduce_fn = _REDUCE_FNS.get(
@@ -265,9 +479,12 @@ class _Lane:
             if p.op == ReduceOp.AVG:
                 for a in acc:
                     np.divide(a, self._world_size, out=a)
+            # Fan out the ENCODED result and return its decoded form, so
+            # the root sees byte-identical values to every peer.
+            wire_out = codec.encode_arrays(acc)
             for _, sock in sorted(self._peer_socks.items()):
-                _send_arrays(sock, acc)
-            return acc
+                _send_arrays(sock, wire_out)
+            return codec.decode_arrays(wire_out, p.arrays)
         if p.opcode == _OP_ALLGATHER:
             gathered = [contributions[r] for r in range(self._world_size)]
             flat: List[np.ndarray] = [
@@ -294,9 +511,13 @@ class _Lane:
             # Root discards non-root contributions for broadcast; send an
             # empty frame instead of the full payload.
             _send_arrays(sock, [])
+        elif p.opcode == _OP_ALLREDUCE:
+            _send_arrays(sock, self._codec.encode_arrays(p.arrays))
         else:
             _send_arrays(sock, p.arrays)
         result = _recv_arrays(sock)
+        if p.opcode == _OP_ALLREDUCE:
+            result = self._codec.decode_arrays(result, p.arrays)
         if p.opcode == _OP_ALLGATHER:
             # Decode the flattened [world, n_0, bufs_0..., n_1, ...] frame.
             idx = 0
@@ -416,6 +637,7 @@ class _Lane:
         if reduce_fn is None:
             raise ValueError(f"unsupported reduce op: {p.op}")
 
+        codec = self._codec
         out = [np.array(np.ascontiguousarray(a), copy=True) for a in p.arrays]
         flats = [a.reshape(-1) for a in out]
 
@@ -426,18 +648,8 @@ class _Lane:
                 views.append(f[s:e])
             return views
 
-        def pack(views: List[np.ndarray]) -> bytes:
-            return b"".join(v.tobytes() for v in views)
-
-        def unpack_into(data: bytes, views: List[np.ndarray], combine) -> None:
-            offset = 0
-            for v in views:
-                nb = v.nbytes
-                incoming = np.frombuffer(
-                    data[offset: offset + nb], dtype=v.dtype
-                )
-                combine(v, incoming)
-                offset += nb
+        def expect_len(views: List[np.ndarray]) -> int:
+            return sum(codec.wire_nbytes(v) for v in views)
 
         # reduce-scatter: after step s, chunk (r - s) was sent onward and
         # chunk (r - s - 1) absorbed; rank r ends owning chunk (r + 1) % n.
@@ -446,29 +658,37 @@ class _Lane:
             recv_c = (r - step - 1) % n
             send_views = chunk_views(send_c)
             recv_views = chunk_views(recv_c)
-            data = self._ring_sendrecv(_OP_ALLREDUCE, step, pack(send_views))
-            if len(data) != sum(v.nbytes for v in recv_views):
-                raise ConnectionError(
-                    "ring allreduce chunk size mismatch (divergent shapes?)"
-                )
-            unpack_into(data, recv_views, reduce_fn)
-
-        # all-gather of the completed chunks
-        for step in range(n - 1):
-            send_c = (r + 1 - step) % n
-            recv_c = (r - step) % n
-            send_views = chunk_views(send_c)
-            recv_views = chunk_views(recv_c)
             data = self._ring_sendrecv(
-                _OP_ALLREDUCE, n - 1 + step, pack(send_views)
+                _OP_ALLREDUCE, step, codec.encode_views(send_views)
             )
-            if len(data) != sum(v.nbytes for v in recv_views):
+            if len(data) != expect_len(recv_views):
                 raise ConnectionError(
                     "ring allreduce chunk size mismatch (divergent shapes?)"
                 )
-            unpack_into(
+            codec.decode_into(data, recv_views, reduce_fn)
+
+        # All-gather of the completed chunks. Each chunk is encoded ONCE
+        # by its owner and the received bytes are forwarded VERBATIM, so
+        # with a lossy codec every rank decodes identical bytes — replicas
+        # stay bitwise consistent. The owner also re-decodes its own
+        # encoded chunk for the same reason.
+        own_c = (r + 1) % n
+        carry = codec.encode_views(chunk_views(own_c))
+        codec.decode_into(
+            carry, chunk_views(own_c), lambda v, inc: np.copyto(v, inc)
+        )
+        for step in range(n - 1):
+            recv_c = (r - step) % n
+            recv_views = chunk_views(recv_c)
+            data = self._ring_sendrecv(_OP_ALLREDUCE, n - 1 + step, carry)
+            if len(data) != expect_len(recv_views):
+                raise ConnectionError(
+                    "ring allreduce chunk size mismatch (divergent shapes?)"
+                )
+            codec.decode_into(
                 data, recv_views, lambda v, inc: np.copyto(v, inc)
             )
+            carry = data
 
         if p.op == ReduceOp.AVG:
             for f in flats:
@@ -481,7 +701,8 @@ class TcpCommContext(CommContext):
     topology; see class ctor)."""
 
     def __init__(self, timeout: "float | timedelta" = 60.0,
-                 algorithm: str = "auto", channels: int = 4) -> None:
+                 algorithm: str = "auto", channels: int = 4,
+                 compression: str = "none") -> None:
         """``algorithm``: "star" (rank 0 reduces and fans out — lowest
         latency for tiny payloads / few replicas), "ring" (bandwidth-optimal
         reduce-scatter + all-gather: each link moves ~2B/n per allreduce
@@ -491,7 +712,14 @@ class TcpCommContext(CommContext):
         ``channels``: number of independent socket lanes; ops are assigned
         round-robin by submission index, so up to ``channels`` collectives
         progress on the wire concurrently (backward/comm overlap for DDP
-        buckets). Must match across ranks."""
+        buckets). Must match across ranks.
+
+        ``compression``: wire codec for ALLREDUCE payloads — "none",
+        "bf16" (2 bytes/elem), "fp16", or "int8" (absmax-scaled,
+        ~1 byte/elem). Lossy codecs still yield IDENTICAL decoded values
+        on every rank (encoded bytes are fanned out / forwarded
+        verbatim), so replica trajectories stay consistent; allgather and
+        broadcast are never compressed. Must match across ranks."""
         super().__init__()
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
@@ -499,6 +727,12 @@ class TcpCommContext(CommContext):
             raise ValueError(f"unknown algorithm {algorithm!r}")
         if channels < 1:
             raise ValueError("channels must be >= 1")
+        if compression not in _CODECS:
+            raise ValueError(
+                f"unknown compression {compression!r}; "
+                f"have {sorted(_CODECS)}"
+            )
+        self._codec = _CODECS[compression]()
         self._algorithm = algorithm
         self._channels = int(channels)
         self._use_ring = False

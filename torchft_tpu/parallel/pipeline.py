@@ -21,10 +21,10 @@ psum-broadcast back.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
-__all__ = ["make_pipeline", "stack_stage_params", "split_microbatches",
-           "merge_microbatches"]
+__all__ = ["make_pipeline", "make_pipeline_1f1b", "stack_stage_params",
+           "split_microbatches", "merge_microbatches"]
 
 
 def stack_stage_params(stage_params_list) -> Any:
@@ -53,25 +53,38 @@ def merge_microbatches(x):
     return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
 
 
+def _get_shard_map():
+    try:
+        from jax import shard_map
+
+        return shard_map, {"check_vma": False}
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+
+        return shard_map, {"check_rep": False}
+
+
 def make_pipeline(mesh, stage_fn: Callable[[Any, Any], Any],
-                  axis: str = "stage"):
+                  axis: str = "stage",
+                  embed_fn: Optional[Callable[[Any], Any]] = None,
+                  readout_fn: Optional[Callable[[Any], Any]] = None):
     """Build a jittable pipelined apply: (stacked_params, microbatches) ->
     outputs, where ``stage_fn(params_for_one_stage, h)`` is one stage's
-    compute and microbatches is [M, mb, ...]."""
+    compute and microbatches is [M, mb, ...].
+
+    ``embed_fn`` (applied on stage 0 only) maps a raw input microbatch to
+    the hidden representation, and ``readout_fn`` (last stage only) maps
+    the final hidden state to the pipeline output — lifting the round-1
+    restriction that inputs/outputs share the hidden shape (e.g. int32
+    token ids in, logits out, [mb, d_model] flowing between stages).
+    ``stage_fn`` itself must still map hidden -> hidden (the inter-stage
+    channel is one SPMD-uniform buffer)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-
-        check_kwargs = {"check_vma": False}
-    except ImportError:  # pragma: no cover — older jax
-        from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
-
-        check_kwargs = {"check_rep": False}
-
+    shard_map, check_kwargs = _get_shard_map()
     num_stages = mesh.shape[axis]
 
     def _body(stacked_params, x):
@@ -81,16 +94,26 @@ def make_pipeline(mesh, stage_fn: Callable[[Any, Any], Any],
         num_mb = x.shape[0]
         ticks = num_mb + num_stages - 1
 
-        state0 = jnp.zeros_like(x[0])
-        out0 = jnp.zeros_like(x)
+        def _embed(mb):
+            return embed_fn(mb) if embed_fn is not None else mb
+
+        def _readout(h):
+            return readout_fn(h) if readout_fn is not None else h
+
+        hidden_sds = jax.eval_shape(_embed, jax.eval_shape(lambda: x[0]))
+        state0 = jnp.zeros(hidden_sds.shape, hidden_sds.dtype)
+        out0 = jnp.zeros((num_mb,) + hidden_sds.shape, hidden_sds.dtype)
         perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+        # Embed once, outside the tick loop (stage 0 is the only consumer;
+        # one batched application instead of one per tick).
+        x_emb = jax.vmap(_embed)(x)
 
         def tick(t, carry):
             state, out = carry
             # stage 0 ingests microbatch t (clamped reads past the end are
             # discarded by the schedule)
             mb_in = lax.dynamic_index_in_dim(
-                x, jnp.clip(t, 0, num_mb - 1), axis=0, keepdims=False
+                x_emb, jnp.clip(t, 0, num_mb - 1), axis=0, keepdims=False
             )
             h = stage_fn(
                 params, jnp.where(stage == 0, mb_in, state)
@@ -112,14 +135,171 @@ def make_pipeline(mesh, stage_fn: Callable[[Any, Any], Any],
             return state, out
 
         _, out = lax.fori_loop(0, ticks, tick, (state0, out0))
-        # outputs live on the last stage; zero elsewhere and psum-broadcast
+        # outputs live on the last stage; zero elsewhere, psum-broadcast,
+        # then one batched readout (not one per tick per stage)
         out = jnp.where(stage == num_stages - 1, out, jnp.zeros_like(out))
-        return lax.psum(out, axis)
+        out = lax.psum(out, axis)
+        return jax.vmap(_readout)(out)
 
     return shard_map(
         _body,
         mesh=mesh,
         in_specs=(P(axis), P()),
         out_specs=P(),
+        **check_kwargs,
+    )
+
+
+def make_pipeline_1f1b(mesh, stage_fn: Callable[[Any, Any], Any],
+                       loss_fn: Callable[[Any, Any], Any],
+                       num_microbatches: int,
+                       axis: str = "stage",
+                       embed_fn: Optional[Callable[[Any], Any]] = None):
+    """Explicit 1F1B training pipeline: (stacked_params, x_mb, y_mb) ->
+    (mean_loss, stacked_param_grads).
+
+    Unlike ``make_pipeline`` + jax.grad (which replays the whole forward
+    schedule before any backward), this follows the 1F1B schedule
+    (schedule.py): each stage starts backwards as soon as its first
+    microbatch returns from the last stage, so peak in-flight activations
+    are bounded by the stage count S instead of the microbatch count M.
+    Per-tick actions come from static schedule tables; idle/active is
+    gated with lax.cond so bubble ticks skip the stage compute.
+
+    ``loss_fn(h_last, y_mb) -> scalar`` plays the readout role on the
+    last stage (its VJP seeds the backward cotangent);
+    ``embed_fn`` (stage 0) lifts raw inputs to the hidden shape.
+    Backward recomputes each stage's forward from the stored stage INPUT
+    (remat-style), so only inputs are buffered."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from torchft_tpu.parallel.schedule import one_f_one_b_schedule
+
+    shard_map, check_kwargs = _get_shard_map()
+    S = mesh.shape[axis]
+    M = num_microbatches
+
+    sched = one_f_one_b_schedule(S, M)
+    T = len(sched)
+    f_tbl = np.full((T, S), -1, np.int32)
+    b_tbl = np.full((T, S), -1, np.int32)
+    for t, row in enumerate(sched):
+        for s, action in enumerate(row):
+            if action is None:
+                continue
+            phase, mb, _ = action
+            (f_tbl if phase == "F" else b_tbl)[t, s] = mb
+
+    def _body(stacked_params, x, y):
+        stage = lax.axis_index(axis)
+        params = jax.tree_util.tree_map(lambda l: l[0], stacked_params)
+        assert x.shape[0] == M, (x.shape, M)
+
+        def _embed(mb):
+            return embed_fn(mb) if embed_fn is not None else mb
+
+        hidden_sds = jax.eval_shape(_embed, jax.eval_shape(lambda: x[0]))
+        zeros_hidden = jnp.zeros(hidden_sds.shape, hidden_sds.dtype)
+        ftbl = jnp.asarray(f_tbl)
+        btbl = jnp.asarray(b_tbl)
+        perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+        perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+        zero_pgrads = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+        def tick(t, carry):
+            h_chan, g_chan, acts, pgrads, loss_acc = carry
+            f_mb = lax.dynamic_index_in_dim(
+                lax.dynamic_index_in_dim(ftbl, t, axis=0, keepdims=False),
+                stage, axis=0, keepdims=False,
+            )
+            b_mb = lax.dynamic_index_in_dim(
+                lax.dynamic_index_in_dim(btbl, t, axis=0, keepdims=False),
+                stage, axis=0, keepdims=False,
+            )
+
+            # ---- forward slot --------------------------------------
+            mb_in = lax.dynamic_index_in_dim(
+                x, jnp.clip(f_mb, 0, M - 1), axis=0, keepdims=False
+            )
+            h_in = jnp.where(stage == 0, _embed(mb_in), h_chan)
+
+            def do_fwd(_):
+                return stage_fn(params, h_in)
+
+            h_out = lax.cond(f_mb >= 0, do_fwd,
+                             lambda _: zeros_hidden, operand=None)
+            # stash the stage INPUT for backward recompute; in-flight
+            # count is bounded by S so slot = mb % S never collides
+            slot = jnp.clip(f_mb, 0, M - 1) % S
+            stored = lax.dynamic_index_in_dim(
+                acts, slot, axis=0, keepdims=False
+            )
+            acts = lax.dynamic_update_index_in_dim(
+                acts,
+                jnp.where(f_mb >= 0, h_in, stored),
+                slot, axis=0,
+            )
+
+            # ---- backward slot -------------------------------------
+            b_slot = jnp.clip(b_mb, 0, M - 1) % S
+            a_in = lax.dynamic_index_in_dim(
+                acts, b_slot, axis=0, keepdims=False
+            )
+            y_mb = lax.dynamic_index_in_dim(
+                y, jnp.clip(b_mb, 0, M - 1), axis=0, keepdims=False
+            )
+
+            def do_bwd(_):
+                def last_stage(_):
+                    def fwd_loss(p, a):
+                        return loss_fn(stage_fn(p, a), y_mb)
+
+                    loss_k, vjp = jax.vjp(fwd_loss, params, a_in)
+                    pg, ag = vjp(jnp.ones_like(loss_k))
+                    return loss_k, pg, ag
+
+                def mid_stage(_):
+                    _, vjp = jax.vjp(stage_fn, params, a_in)
+                    pg, ag = vjp(g_chan)
+                    return jnp.zeros(()), pg, ag
+
+                return lax.cond(stage == S - 1, last_stage, mid_stage,
+                                operand=None)
+
+            def no_bwd(_):
+                return jnp.zeros(()), zero_pgrads, zeros_hidden
+
+            loss_k, pg, ag = lax.cond(b_mb >= 0, do_bwd, no_bwd,
+                                      operand=None)
+            pgrads = jax.tree_util.tree_map(
+                lambda acc, g: acc + g, pgrads, pg
+            )
+            loss_acc = loss_acc + loss_k
+
+            h_chan = lax.ppermute(h_out, axis, perm_fwd)
+            g_chan = lax.ppermute(ag, axis, perm_bwd)
+            return h_chan, g_chan, acts, pgrads, loss_acc
+
+        acts0 = jnp.zeros((S,) + hidden_sds.shape, hidden_sds.dtype)
+        carry0 = (zeros_hidden, zeros_hidden, acts0, zero_pgrads,
+                  jnp.zeros(()))
+        _, _, _, pgrads, loss_acc = lax.fori_loop(0, T, tick, carry0)
+
+        mean_loss = lax.psum(loss_acc, axis) / M
+        pgrads = jax.tree_util.tree_map(
+            lambda l: (l / M)[None], pgrads
+        )
+        return mean_loss, pgrads
+
+    return shard_map(
+        _body,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P()),
+        out_specs=(P(), P(axis)),
         **check_kwargs,
     )

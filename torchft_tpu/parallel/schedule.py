@@ -1,0 +1,200 @@
+"""Pipeline schedules: GPipe, 1F1B, interleaved 1F1B — as data.
+
+A schedule is a list of ticks; each tick is a list of per-stage actions
+(one slot per pipeline stage): ``None`` (idle) or ``(phase, microbatch,
+chunk)`` with phase "F"/"B". Unit-time F and B slots (the classic
+schedule-analysis model). These drive both analysis/tests (bubble
+fraction, peak in-flight activations) and the executable 1F1B runner
+(pipeline.py), which lowers the same tables into masked lax ops.
+
+Schedule facts encoded here (and asserted by tests):
+- GPipe and non-interleaved 1F1B have the SAME makespan / bubble
+  (2(S-1) idle slots per stage); 1F1B's win is peak in-flight
+  activations S vs GPipe's M.
+- Interleaved 1F1B (V chunks per device, Megatron-style) cuts the
+  warmup/cooldown bubble by ~1/V at the cost of V× more p2p hops.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+__all__ = [
+    "gpipe_schedule",
+    "one_f_one_b_schedule",
+    "interleaved_1f1b_schedule",
+    "bubble_fraction",
+    "peak_inflight_activations",
+    "validate_schedule",
+]
+
+Action = Optional[Tuple[str, int, int]]  # (phase, microbatch, chunk)
+
+
+def _to_ticks(events, num_stages: int) -> List[List[Action]]:
+    """events: dict[(tick, stage)] -> action; densify into tick rows."""
+    if not events:
+        return []
+    horizon = max(t for t, _ in events) + 1
+    ticks: List[List[Action]] = [
+        [None] * num_stages for _ in range(horizon)
+    ]
+    for (t, s), action in events.items():
+        assert ticks[t][s] is None, f"collision at tick {t} stage {s}"
+        ticks[t][s] = action
+    return ticks
+
+
+def gpipe_schedule(num_stages: int, num_microbatches: int) -> List[List[Action]]:
+    """All forwards, then all backwards (fill + drain twice)."""
+    S, M = num_stages, num_microbatches
+    events = {}
+    for k in range(M):
+        for s in range(S):
+            events[(s + k, s)] = ("F", k, 0)
+    fwd_end = S - 1 + M  # first tick after the last stage's last forward
+    for k in range(M):
+        for s in reversed(range(S)):
+            events[(fwd_end + (S - 1 - s) + k, s)] = ("B", k, 0)
+    return _to_ticks(events, S)
+
+
+def one_f_one_b_schedule(
+    num_stages: int, num_microbatches: int
+) -> List[List[Action]]:
+    """Non-interleaved 1F1B: stage s runs F(k) at tick s+2k and B(k) at
+    tick 2S-1-s+2k — warmup of S-1-s forwards, then strict FB
+    alternation, then drain. Same makespan as GPipe; peak in-flight
+    activations bounded by the stage depth instead of M."""
+    S, M = num_stages, num_microbatches
+    events = {}
+    for k in range(M):
+        for s in range(S):
+            events[(s + 2 * k, s)] = ("F", k, 0)
+            events[(2 * S - 1 - s + 2 * k, s)] = ("B", k, 0)
+    return _to_ticks(events, S)
+
+
+def interleaved_1f1b_schedule(
+    num_stages: int, num_microbatches: int, interleave: int
+) -> List[List[Action]]:
+    """Megatron-style interleaved schedule: each device owns ``interleave``
+    model chunks (device s holds chunks c, i.e. virtual stages v = c*S+s);
+    microbatches traverse all V*S virtual stages. Built by greedy
+    list-scheduling against the true dependency DAG (correct by
+    construction: no slot collisions, all dependencies respected), with
+    the 1F1B discipline of preferring a ready backward once steady state
+    is reached — shrinking the warmup/cooldown bubble toward (S-1)/V of
+    GPipe's relative to useful work V*M."""
+    S, M, V = num_stages, num_microbatches, interleave
+    if V == 1:
+        return one_f_one_b_schedule(S, M)
+    total_v = V * S
+    done_f: set = set()   # (v, k) forward completed (before this tick)
+    done_b: set = set()
+    schedule: List[List[Action]] = []
+    remaining = 2 * total_v * M
+    horizon = 8 * (total_v + V * M + 4)  # generous deadlock backstop
+    while remaining and len(schedule) < horizon:
+        row: List[Action] = [None] * S
+        chosen = []
+        for s in range(S):
+            f_cands = []
+            b_cands = []
+            for c in range(V):
+                v = c * S + s
+                for k in range(M):
+                    if (v, k) in done_f:
+                        continue
+                    if v == 0 or (v - 1, k) in done_f:
+                        f_cands.append((k, c, v))
+                    break  # per virtual stage, mbs go in order
+                for k in range(M):
+                    if (v, k) in done_b:
+                        continue
+                    if (v, k) in done_f and (
+                        v == total_v - 1 or (v + 1, k) in done_b
+                    ):
+                        b_cands.append((k, c, v))
+                    break
+            if b_cands:  # 1F1B: drain a backward whenever one is ready
+                k, c, v = min(b_cands)
+                row[s] = ("B", k, c)
+                chosen.append(("B", v, k))
+            elif f_cands:
+                k, c, v = min(f_cands)
+                row[s] = ("F", k, c)
+                chosen.append(("F", v, k))
+        assert chosen, "interleaved schedule deadlocked"
+        for phase, v, k in chosen:
+            (done_f if phase == "F" else done_b).add((v, k))
+            remaining -= 1
+        schedule.append(row)
+    assert remaining == 0, "interleaved schedule did not complete"
+    return schedule
+
+
+def bubble_fraction(schedule: List[List[Action]]) -> float:
+    """Idle slots / total slots over the schedule's makespan."""
+    total = sum(len(t) for t in schedule)
+    idle = sum(1 for t in schedule for a in t if a is None)
+    return idle / total
+
+
+def peak_inflight_activations(schedule: List[List[Action]]) -> int:
+    """Max, over stages, of simultaneously stored forward activations
+    (stored at F, freed at the matching B)."""
+    peak = 0
+    num_stages = len(schedule[0]) if schedule else 0
+    for s in range(num_stages):
+        live = set()
+        for t in range(len(schedule)):
+            a = schedule[t][s]
+            if a is None:
+                continue
+            phase, mb, chunk = a
+            if phase == "F":
+                live.add((mb, chunk))
+                peak = max(peak, len(live))
+            else:
+                live.discard((mb, chunk))
+    return peak
+
+
+def validate_schedule(
+    schedule: List[List[Action]], num_stages: int, num_microbatches: int,
+    interleave: int = 1,
+) -> None:
+    """Structural checks: every (mb, chunk) F and B happens exactly once
+    per stage, F(s) precedes F(s+1) (data dependency), B(s+1) precedes
+    B(s), and all Bs follow the last virtual stage's F."""
+    f_ticks = {}
+    b_ticks = {}
+    for t, row in enumerate(schedule):
+        for s, a in enumerate(row):
+            if a is None:
+                continue
+            phase, mb, chunk = a
+            key = (s, mb, chunk)
+            store = f_ticks if phase == "F" else b_ticks
+            assert key not in store, f"duplicate {phase} {key}"
+            store[key] = t
+    expect = num_stages * num_microbatches * interleave
+    assert len(f_ticks) == expect, (len(f_ticks), expect)
+    assert len(b_ticks) == expect, (len(b_ticks), expect)
+    for (s, mb, chunk), t in f_ticks.items():
+        # forward data dependency along virtual stages
+        v = chunk * num_stages + s
+        if v + 1 < num_stages * interleave:
+            s2, c2 = (v + 1) % num_stages, (v + 1) // num_stages
+            assert f_ticks[(s2, mb, c2)] > t, (
+                f"F dependency violated at mb={mb} v={v}"
+            )
+        assert b_ticks[(s, mb, chunk)] > t, f"B before F at {(s, mb, chunk)}"
+    for (s, mb, chunk), t in b_ticks.items():
+        v = chunk * num_stages + s
+        if v - 1 >= 0:
+            s2, c2 = (v - 1) % num_stages, (v - 1) // num_stages
+            assert b_ticks[(s2, mb, c2)] > t, (
+                f"B dependency violated at mb={mb} v={v}"
+            )
