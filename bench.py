@@ -6,8 +6,10 @@ chip). Two measurements:
   T0  fault-free tokens/sec: the bare jitted train step.
   T1  FT tokens/sec: full torchft_tpu loop — per-step quorum against a real
       in-process lighthouse + native manager, cross-replica gradient
-      averaging through the Manager (solo-quorum fast path), two-phase
-      commit — i.e. BASELINE config-style DDP with one replica group.
+      averaging through the Manager, two-phase commit. By default a second
+      (host-side, zero-gradient) replica participates in every quorum and
+      allreduce, so T1 includes REAL cross-replica transport cost rather
+      than the solo-quorum fast path (BENCH_REPLICAS=1 restores solo).
 
 On a non-CPU backend the bench also A/B-tests the pallas flash-attention
 kernel against the XLA attention path and uses the faster one (after a
@@ -208,13 +210,22 @@ def main() -> None:
     del p0, s0
 
     # ---- T1: full FT loop ----------------------------------------------
-    lighthouse = Lighthouse(min_replicas=1, join_timeout_ms=100)
+    # BENCH_REPLICAS=2 (default): a host-side "echo" replica participates
+    # in every quorum and contributes zero gradients through the same
+    # bucket plan, so T1 pays REAL cross-replica transport (serialization,
+    # framing, reduction) instead of the solo-quorum fast path.
+    n_replicas = int(os.environ.get("BENCH_REPLICAS", "2"))
+    grad_step = make_grad_step(cfg, attn_fn=attn_fn)
+
+    lighthouse = Lighthouse(
+        min_replicas=n_replicas, join_timeout_ms=2000
+    )
     store = StoreServer()
     params_ft = init_params(cfg, key)
     opt_state_holder = {"params": params_ft, "opt": tx.init(params_ft)}
 
     manager = Manager(
-        comm=TcpCommContext(timeout=30.0),
+        comm=TcpCommContext(timeout=60.0),
         load_state_dict=lambda sd: opt_state_holder.update(sd),
         state_dict=lambda: dict(opt_state_holder),
         min_replica_size=1,
@@ -222,14 +233,90 @@ def main() -> None:
         world_size=1,
         store_addr=store.addr,
         lighthouse_addr=lighthouse.address(),
-        replica_id="bench_",
-        timeout=30.0,
-        quorum_timeout=30.0,
-        connect_timeout=30.0,
+        replica_id="bench0_",
+        timeout=60.0,
+        quorum_timeout=60.0,
+        connect_timeout=60.0,
     )
     ddp = DistributedDataParallel(manager)
     opt = OptimizerWrapper(manager, tx)
-    grad_step = make_grad_step(cfg, attn_fn=attn_fn)
+
+    echo_stop = None
+    echo_threads = []
+    echo_stores = []
+    if n_replicas >= 2:
+        import threading
+
+        from torchft_tpu.ddp import _BucketPlan, _DEFAULT_BUCKET_BYTES
+
+        grad_sds = jax.eval_shape(
+            grad_step, params_ft, tokens, targets
+        )[1]
+        zero_leaves = [
+            np.zeros(l.shape, l.dtype)
+            for l in jax.tree_util.tree_leaves(grad_sds)
+        ]
+        plan = _BucketPlan(zero_leaves, _DEFAULT_BUCKET_BYTES)
+        zero_buckets = [
+            plan.pack_bucket([zero_leaves[i] for i in bucket])
+            for bucket in plan.buckets
+        ]
+        echo_stop = threading.Event()
+
+        def _echo_replica(idx: int, echo_store) -> None:
+            try:
+                state = {"x": np.zeros(1, np.float32)}
+                mgr2 = Manager(
+                    comm=TcpCommContext(timeout=60.0),
+                    load_state_dict=lambda sd: state.update(sd),
+                    state_dict=lambda: dict(state),
+                    min_replica_size=1,
+                    rank=0,
+                    world_size=1,
+                    store_addr=echo_store.addr,
+                    lighthouse_addr=lighthouse.address(),
+                    replica_id=f"bench{idx}_",
+                    timeout=60.0,
+                    quorum_timeout=60.0,
+                    connect_timeout=60.0,
+                )
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"bench: echo replica {idx} failed to "
+                                 f"start: {e}\n")
+                return
+            try:
+                while not echo_stop.is_set():
+                    try:
+                        # allow_heal=False: the echo replica must never pull
+                        # the main replica's full model state at bootstrap
+                        mgr2.start_quorum(allow_heal=False)
+                        works = [
+                            mgr2.allreduce_arrays([b.copy()])
+                            for b in zero_buckets
+                        ]
+                        for w in works:
+                            w.future().result(timeout=60)
+                        mgr2.should_commit()
+                    except Exception as e:  # noqa: BLE001 — any transport
+                        # hiccup: keep the quorum population alive, the
+                        # bench depends on this replica existing
+                        if echo_stop.is_set():
+                            return
+                        sys.stderr.write(
+                            f"bench: echo {idx} step retry: {e}\n"
+                        )
+            finally:
+                mgr2.shutdown(wait=False)
+
+        for idx in range(1, n_replicas):
+            echo_store = StoreServer()
+            echo_stores.append(echo_store)
+            t = threading.Thread(
+                target=_echo_replica, args=(idx, echo_store),
+                name=f"bench_echo{idx}", daemon=True,
+            )
+            t.start()
+            echo_threads.append(t)
 
     committed = 0
     attempted = 0
@@ -261,9 +348,15 @@ def main() -> None:
     t1_elapsed = time.perf_counter() - t_start
     t1 = tokens_per_step * steps / t1_elapsed
 
+    if echo_stop is not None:
+        echo_stop.set()
     manager.shutdown(wait=False)
+    lighthouse.shutdown()  # fails echoes' in-flight long-polls fast
+    for t in echo_threads:
+        t.join(timeout=10)
     store.shutdown()
-    lighthouse.shutdown()
+    for s in echo_stores:
+        s.shutdown()
 
     flops_step = _flops_per_step(cfg, n_params, tokens_per_step)
     if backend != "cpu":
@@ -291,6 +384,7 @@ def main() -> None:
                     None if flash_err != flash_err else flash_err
                 ),
                 "commit_rate": committed / max(1, attempted),
+                "replicas": n_replicas,
                 "model": model_name,
                 "params_m": round(n_params / 1e6, 1),
                 "batch": batch,

@@ -6,10 +6,14 @@ runs only over the k-blocks at or before the diagonal, so causal attention
 does half the FLOPs of the dense path. Scores/accumulation in f32 on the
 MXU (preferred_element_type), inputs/outputs bf16.
 
-Backward: a custom_vjp whose backward pass recomputes attention with the
-XLA reference path — gradients are exact; the flash memory win applies to
-the forward (and the backward lives under the model's per-layer remat,
-models/transformer.py). A fused pallas backward is a later optimization.
+Backward: fused FlashAttention-2-style pallas kernels in the resident-KV
+regime — residuals are (q, k, v, out, lse); delta = rowsum(dO·O) is a
+cheap XLA reduce; a dQ kernel sweeps k-blocks per q-block and a dK/dV
+kernel sweeps q-blocks per k-block, recomputing P = exp(S − lse) tile by
+tile so nothing [S, S]-shaped ever touches HBM in either direction. The
+streamed long-context regime falls back to differentiating the XLA
+reference formulation (exact; a k-streamed pallas backward is the
+remaining kernel).
 
 Use interpret=True (or TORCHFT_TPU_PALLAS_INTERPRET=1) to run the same
 kernel on CPU for tests.
@@ -35,8 +39,8 @@ __all__ = ["flash_attention"]
 _NEG_INF = -1e30  # avoid nan from (-inf) - (-inf) in the running max
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
-                  seq_len: int, causal: bool, scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
+                  block_k: int, seq_len: int, causal: bool, scale: float):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
     d = q.shape[-1]
@@ -82,10 +86,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
     acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
     l = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
 
 
-def _flash_streamed_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
-                           l_ref, *, block_q: int, block_k: int,
+def _flash_streamed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
+                           m_ref, l_ref, *, block_q: int, block_k: int,
                            num_k_blocks: int, causal: bool, scale: float):
     """K-blocks ride the innermost grid dimension: only (block_k, d) K/V
     tiles are VMEM-resident at a time, so sequence length is bounded by
@@ -139,6 +144,7 @@ def _flash_streamed_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         l = l_ref[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, :1] + jnp.log(l))[:, 0]
 
 
 # KV footprint above which the k-streamed kernel is used (resident variant
@@ -148,9 +154,13 @@ _RESIDENT_KV_BYTES = 2 * 1024 * 1024
 
 def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
                    block_k: int, interpret: bool):
-    """q,k,v: [BH, S, D] -> [BH, S, D]."""
+    """q,k,v: [BH, S, D] -> (out [BH, S, D], lse [BH, S] f32)."""
     bh, seq_len, d = q.shape
     kv_bytes = 2 * seq_len * d * q.dtype.itemsize
+    out_shapes = (
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct((bh, seq_len), jnp.float32),
+    )
     if kv_bytes <= _RESIDENT_KV_BYTES:
         grid = (bh, seq_len // block_q)
         kernel = functools.partial(
@@ -169,8 +179,11 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
                 pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
                 pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            ],
+            out_shape=out_shapes,
             interpret=interpret,
         )(q, k, v)
 
@@ -198,11 +211,183 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=out_shapes,
         scratch_shapes=scratch,
         interpret=interpret,
     )(q, k, v)
+
+
+# ------------------------------------------------------------- backward pass
+# FlashAttention-2 style fused backward: residuals are (q, k, v, out, lse);
+# delta = rowsum(dO * O) is a cheap XLA elementwise+reduce; two kernels
+# recompute P = exp(S - lse) tile-by-tile — dQ sweeps k-blocks per q-block,
+# dK/dV sweeps q-blocks per k-block. Nothing [S, S]-shaped ever
+# materializes in HBM in either direction.
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_q: int, block_k: int,
+                         seq_len: int, causal: bool, scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale      # [BQ, D]
+    do = do_ref[0].astype(jnp.float32)            # [BQ, D]
+    lse = lse_ref[0]                              # [BQ]
+    delta = delta_ref[0]                          # [BQ]
+    d = q.shape[-1]
+
+    num_k_blocks = seq_len // block_k
+    if causal:
+        last_block = ((qi + 1) * block_q + block_k - 1) // block_k
+        upper = jnp.minimum(num_k_blocks, last_block)
+    else:
+        upper = num_k_blocks
+
+    dq0 = jnp.zeros((block_q, d), dtype=jnp.float32)
+
+    def body(ki, dq):
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(0, upper, body, dq0)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, block_k: int,
+                          seq_len: int, causal: bool, scale: float):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)              # [BK, D]
+    v = v_ref[0].astype(jnp.float32)
+    d = k.shape[-1]
+
+    num_q_blocks = seq_len // block_q
+    lower = (ki * block_k) // block_q if causal else 0
+
+    dk0 = jnp.zeros((block_k, d), dtype=jnp.float32)
+    dv0 = jnp.zeros((block_k, d), dtype=jnp.float32)
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(
+            jnp.float32
+        ) * scale
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])              # [BQ, BK]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        # q already carries `scale`, so ds^T @ q includes dL/dk's scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(lower, num_q_blocks, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal: bool, scale: float,
+                    block_q: int, block_k: int, interpret: bool):
+    """Fused pallas backward (resident K/V and Q/dO variants)."""
+    bh, seq_len, d = q.shape
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [BH, S]
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+        seq_len=seq_len, causal=causal, scale=scale,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, seq_len // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+        seq_len=seq_len, causal=causal, scale=scale,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, seq_len // block_k),
+        in_specs=[
+            pl.BlockSpec((1, seq_len, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, seq_len, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, seq_len), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, seq_len), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 def _reference(q, k, v, causal: bool, scale: float):
@@ -218,17 +403,36 @@ def _reference(q, k, v, causal: bool, scale: float):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, interpret
+    )
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, interpret
+    )
+    bh, seq_len, d = q.shape
+    kv_bytes = 2 * seq_len * d * q.dtype.itemsize
+    if kv_bytes <= _RESIDENT_KV_BYTES:
+        return out, (q, k, v, out, lse)
+    # Streamed regime: its backward fallback only differentiates the
+    # reference formulation from (q, k, v) — don't pin out/lse in HBM
+    # across the whole backward in exactly the memory-bound regime.
+    return out, (q, k, v, None, None)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    # Exact gradients by differentiating the reference formulation.
+    q, k, v, out, lse = residuals
+    if out is not None:
+        return _flash_backward(
+            q, k, v, out, lse, g, causal, scale, block_q, block_k,
+            interpret,
+        )
+    # Long-context fallback: exact gradients by differentiating the
+    # reference formulation (a streamed pallas backward is a later
+    # optimization; the fused path above covers the resident regime).
     _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, causal, scale),
                      q, k, v)
     return vjp(g)
