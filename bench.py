@@ -305,6 +305,9 @@ def main() -> None:
                         sys.stderr.write(
                             f"bench: echo {idx} step retry: {e}\n"
                         )
+                        # backoff: never spin-burn CPU on the machine
+                        # whose throughput is being measured
+                        echo_stop.wait(0.2)
             finally:
                 mgr2.shutdown(wait=False)
 
@@ -317,6 +320,7 @@ def main() -> None:
             )
             t.start()
             echo_threads.append(t)
+
 
     committed = 0
     attempted = 0
@@ -338,7 +342,39 @@ def main() -> None:
             opt_state_holder["opt"] = s
         return loss
 
-    for _ in range(warmup):
+    # Bring-up gate: the first warmup step doubles as proof that the
+    # n-replica FT loop actually commits (same per-round op sequence as
+    # the echoes, so no desync). If it can't — an echo died, port
+    # conflicts — re-run solo rather than emitting garbage labelled
+    # replicas=N.
+    loss = ft_step()
+    if n_replicas >= 2 and committed == 0:
+        alive = sum(t.is_alive() for t in echo_threads)
+        sys.stderr.write(
+            f"bench: {n_replicas}-replica first step failed to commit "
+            f"({alive}/{len(echo_threads)} echoes alive); re-running "
+            "solo\n"
+        )
+        echo_stop.set()
+        manager.shutdown(wait=False)
+        lighthouse.shutdown()
+        store.shutdown()
+        for s_ in echo_stores:
+            s_.shutdown()
+        env = dict(os.environ)
+        env["BENCH_REPLICAS"] = "1"
+        env.setdefault("BENCH_NO_FALLBACK", "1")
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True,
+        )
+        sys.stdout.write(out.stdout)
+        sys.stderr.write(out.stderr)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(out.returncode)
+
+    for _ in range(warmup - 1):
         loss = ft_step()
     jax.block_until_ready(loss)
     t_start = time.perf_counter()

@@ -132,10 +132,9 @@ def test_fused_backward_matches_reference(causal) -> None:
         )
 
 
-def test_streamed_backward_fallback_matches() -> None:
-    # Long-context (streamed) regime falls back to the reference VJP;
-    # gradients must stay exact there too, and the streamed forward's lse
-    # output must not break the custom_vjp plumbing.
+def test_streamed_backward_matches() -> None:
+    # Long-context (streamed) regime now runs the k/q-streamed fused
+    # backward kernels; gradients must match the reference exactly.
     import torchft_tpu.ops.flash as flash_mod
 
     old = flash_mod._RESIDENT_KV_BYTES

@@ -34,9 +34,71 @@ def test_ft_mesh_replica_axis_is_virtual() -> None:
     manager.num_participants.return_value = 3
     ftm = FTMesh(manager, mesh)
     assert ftm.num_replicas() == 3
-    assert "replica" not in ftm.axis_names  # never in the compiled mesh
+    # the managed VIEW includes the virtual axis (ref ManagedDeviceMesh
+    # shape :1210-1214) but the COMPILED mesh never does
+    assert ftm.axis_names == ("replica", "data")
+    assert "replica" not in ftm.mesh.axis_names
+    with pytest.raises(ValueError, match="virtual replica"):
+        FTMesh(manager, ft_mesh({"replica": 8}))
     manager.num_participants.return_value = 0
     assert ftm.num_replicas() == 1  # reported >=1 (ref pg.py:1187-1202)
+
+
+def test_ft_mesh_composition_surface() -> None:
+    # getitem / size / coordinate / flatten / get_comm parity with the
+    # reference's ManagedDeviceMesh (process_group.py:1086-1261),
+    # rendered as axis selections over one physical mesh.
+    from unittest.mock import MagicMock
+
+    from torchft_tpu.comm.context import ManagedCommContext
+
+    mesh = ft_mesh({"data": 2, "fsdp": 4})
+    manager = MagicMock()
+    manager.num_participants.return_value = 3
+    manager.participating_rank.return_value = 2
+    ftm = FTMesh(manager, mesh)
+
+    # shape/size include the virtual axis
+    assert ftm.shape == {"replica": 3, "data": 2, "fsdp": 4}
+    assert ftm.size() == 24
+    assert ftm.size("replica") == 3 and ftm.size("fsdp") == 4
+    assert ftm.ndim == 3
+
+    # getitem: replica selection -> FTMesh view NARROWED to the selected
+    # in-group axes; in-group-only -> pspec names
+    sub = ftm[("replica", "fsdp")]
+    assert isinstance(sub, FTMesh)
+    assert sub.shape == {"replica": 3, "fsdp": 4}
+    assert sub.size() == 12  # not 24: "data" is outside the view
+    with pytest.raises(KeyError):
+        sub.axis_size("data")
+    rep_only = ftm["replica"]
+    assert rep_only.axis_names == ("replica",)
+    assert rep_only.size() == 3
+    with pytest.raises(ValueError, match="replica-only"):
+        rep_only.sharding(None)
+    assert ftm["fsdp"] == "fsdp"
+    assert ftm[("data", "fsdp")] == ("data", "fsdp")
+    with pytest.raises(KeyError):
+        ftm["bogus"]
+
+    # get_comm: replica axis -> Manager-backed context; in-group -> name
+    assert isinstance(ftm.get_comm("replica"), ManagedCommContext)
+    assert isinstance(ftm.get_comm(), ManagedCommContext)
+    assert ftm.get_comm("data") == "data"
+
+    # flatten fragment usable inside a PartitionSpec
+    frag = ftm.flattened_spec("data", "fsdp")
+    assert frag == ("data", "fsdp")
+    s = ftm.sharding(frag, None)
+    assert s.spec == P(("data", "fsdp"), None)
+    with pytest.raises(ValueError, match="virtual"):
+        ftm.flattened_spec("replica")
+
+    # coordinate: device indices + replica rank
+    dev = mesh.devices[1][2]
+    coord = ftm.coordinate(dev)
+    assert coord == {"replica": 2, "data": 1, "fsdp": 2}
 
 
 def test_fsdp_sharding_largest_dim() -> None:

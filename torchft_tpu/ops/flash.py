@@ -10,10 +10,10 @@ Backward: fused FlashAttention-2-style pallas kernels in the resident-KV
 regime — residuals are (q, k, v, out, lse); delta = rowsum(dO·O) is a
 cheap XLA reduce; a dQ kernel sweeps k-blocks per q-block and a dK/dV
 kernel sweeps q-blocks per k-block, recomputing P = exp(S − lse) tile by
-tile so nothing [S, S]-shaped ever touches HBM in either direction. The
-streamed long-context regime falls back to differentiating the XLA
-reference formulation (exact; a k-streamed pallas backward is the
-remaining kernel).
+tile so nothing [S, S]-shaped ever touches HBM in either direction. Both
+regimes are fused: resident kernels hold K/V (resp. Q/dO) in VMEM for
+short/medium sequences; streamed kernels ride tiles over the innermost
+grid dimension with VMEM scratch accumulators for long context.
 
 Use interpret=True (or TORCHFT_TPU_PALLAS_INTERPRET=1) to run the same
 kernel on CPU for tests.
@@ -229,6 +229,32 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
 # materializes in HBM in either direction.
 
 
+def _bwd_p_ds(q_scaled, k, v, do, lse, delta, qi, ki, block_q: int,
+              block_k: int, causal: bool):
+    """Shared score recompute for every backward kernel: P = exp(S − lse)
+    with the causal mask, and dS = P ⊙ (dO·Vᵀ − Δ). One definition so
+    mask/softmax changes can never diverge between regimes."""
+    s = jax.lax.dot_general(
+        q_scaled, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta[:, None])
+    return p, ds
+
+
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, *, block_q: int, block_k: int,
                          seq_len: int, causal: bool, scale: float):
@@ -251,24 +277,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def body(ki, dq):
         k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        _, ds = _bwd_p_ds(
+            q, k, v, do, lse, delta, qi, ki, block_q, block_k, causal
         )
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta[:, None])
         return dq + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -300,28 +311,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
         lse = lse_ref[0, pl.ds(qi * block_q, block_q)]
         delta = delta_ref[0, pl.ds(qi * block_q, block_q)]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        p, ds = _bwd_p_ds(
+            q, k, v, do, lse, delta, qi, ki, block_q, block_k, causal
         )
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])              # [BQ, BK]
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta[:, None])
         # q already carries `scale`, so ds^T @ q includes dL/dk's scale
         dk = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -334,13 +330,167 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _flash_bwd_dq_streamed_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                  delta_ref, dq_ref, dq_acc, *,
+                                  block_q: int, block_k: int,
+                                  num_k_blocks: int, causal: bool,
+                                  scale: float):
+    """K/V tiles ride the innermost grid dim (long-context regime); dq
+    accumulates in VMEM scratch across the k sweep."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    relevant = (
+        ki * block_k < (qi + 1) * block_q if causal else ki >= 0
+    )
+
+    @pl.when(relevant)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        _, ds = _bwd_p_ds(
+            q, k, v, do, lse, delta, qi, ki, block_q, block_k, causal
+        )
+        dq_acc[...] = dq_acc[...] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_streamed_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                   delta_ref, dk_ref, dv_ref, dk_acc,
+                                   dv_acc, *, block_q: int, block_k: int,
+                                   num_q_blocks: int, causal: bool,
+                                   scale: float):
+    """Q/dO tiles ride the innermost grid dim; dk/dv accumulate in VMEM
+    scratch across the q sweep."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # causal: this q block contributes iff its last row can see the k
+    # block's first column
+    relevant = (
+        (qi + 1) * block_q > ki * block_k if causal else qi >= 0
+    )
+
+    @pl.when(relevant)
+    def _accumulate():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32) * scale
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        p, ds = _bwd_p_ds(
+            q, k, v, do, lse, delta, qi, ki, block_q, block_k, causal
+        )
+        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # q already carries `scale`, so ds^T @ q includes dL/dk's scale
+        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward_streamed(q, k, v, g, lse, delta, causal: bool,
+                             scale: float, block_q: int, block_k: int,
+                             interpret: bool):
+    bh, seq_len, d = q.shape
+    num_q_blocks = seq_len // block_q
+    num_k_blocks = seq_len // block_k
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_streamed_kernel, block_q=block_q,
+            block_k=block_k, num_k_blocks=num_k_blocks, causal=causal,
+            scale=scale,
+        ),
+        grid=(bh, num_q_blocks, num_k_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_streamed_kernel, block_q=block_q,
+            block_k=block_k, num_q_blocks=num_q_blocks, causal=causal,
+            scale=scale,
+        ),
+        grid=(bh, num_k_blocks, num_q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
 def _flash_backward(q, k, v, out, lse, g, causal: bool, scale: float,
                     block_q: int, block_k: int, interpret: bool):
-    """Fused pallas backward (resident K/V and Q/dO variants)."""
+    """Fused pallas backward: resident variant (full K/V resp. Q/dO in
+    VMEM) below the threshold, streamed tiles above it."""
     bh, seq_len, d = q.shape
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )  # [BH, S]
+    kv_bytes = 2 * seq_len * d * q.dtype.itemsize
+    if kv_bytes > _RESIDENT_KV_BYTES:
+        return _flash_backward_streamed(
+            q, k, v, g, lse, delta, causal, scale, block_q, block_k,
+            interpret,
+        )
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, block_q=block_q, block_k=block_k,
@@ -413,29 +563,14 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     out, lse = _flash_forward(
         q, k, v, causal, scale, block_q, block_k, interpret
     )
-    bh, seq_len, d = q.shape
-    kv_bytes = 2 * seq_len * d * q.dtype.itemsize
-    if kv_bytes <= _RESIDENT_KV_BYTES:
-        return out, (q, k, v, out, lse)
-    # Streamed regime: its backward fallback only differentiates the
-    # reference formulation from (q, k, v) — don't pin out/lse in HBM
-    # across the whole backward in exactly the memory-bound regime.
-    return out, (q, k, v, None, None)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
     q, k, v, out, lse = residuals
-    if out is not None:
-        return _flash_backward(
-            q, k, v, out, lse, g, causal, scale, block_q, block_k,
-            interpret,
-        )
-    # Long-context fallback: exact gradients by differentiating the
-    # reference formulation (a streamed pallas backward is a later
-    # optimization; the fused path above covers the resident regime).
-    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, causal, scale),
-                     q, k, v)
-    return vjp(g)
+    return _flash_backward(
+        q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -70,20 +70,95 @@ def ft_mesh(
     return jax.sharding.Mesh(device_array, tuple(names))
 
 
+REPLICA_AXIS = "replica"
+
+
 class FTMesh:
     """Static in-group mesh + dynamic (quorum-driven) replica dimension
-    (the ManagedDeviceMesh analog, ref process_group.py:1086-1261)."""
+    (the ManagedDeviceMesh analog, ref process_group.py:1086-1261).
 
-    def __init__(self, manager, mesh) -> None:
+    Composition surface parity with the reference's ManagedDeviceMesh —
+    rendered JAX-style, where sub-"meshes" are axis selections over ONE
+    physical mesh (jax composes via PartitionSpecs, not by materializing
+    child mesh objects):
+
+    - ``shape`` / ``size()`` / ``ndim`` include the virtual replica axis
+      (ref :1187-1214); the replica size is the live participant count,
+      reported >= 1 even with zero participants.
+    - ``ftmesh[names]`` (getitem, ref :1127-1158) returns an FTMesh view
+      when the replica axis is selected, else the axis-name tuple to use
+      directly in a PartitionSpec.
+    - ``get_comm(axis)`` (the get_group analog, ref :1163-1175): the
+      replica axis resolves to a ManagedCommContext over the Manager; an
+      in-group axis resolves to its name (collectives over it are
+      compiled jax.lax ops inside shard_map).
+    - ``flattened_spec(*names)`` (the _flatten analog, ref :1177-1185):
+      a PartitionSpec fragment sharding one array dim over several axes.
+    - ``coordinate(device)`` (get_coordinate, ref :1243-1258): per-axis
+      indices including the replica rank.
+    """
+
+    def __init__(self, manager, mesh,
+                 replica_axis: str = REPLICA_AXIS,
+                 selected: Optional[Tuple[str, ...]] = None) -> None:
+        """``selected``: restrict the view to these in-group axes (set by
+        __getitem__); None = all of the mesh's axes."""
         self.manager = manager
         self.mesh = mesh
+        self.replica_axis = replica_axis
+        if mesh is not None and replica_axis in mesh.axis_names:
+            raise ValueError(
+                f"in-group mesh must not contain the virtual replica "
+                f"axis {replica_axis!r}"
+            )
+        if selected is not None:
+            for n in selected:
+                if mesh is None or n not in mesh.axis_names:
+                    raise KeyError(f"unknown mesh axis {n!r}")
+        self._selected = selected
+
+    # ------------------------------------------------------------ axis info
+
+    def _in_group_names(self) -> Tuple[str, ...]:
+        if self._selected is not None:
+            return self._selected
+        return tuple(self.mesh.axis_names) if self.mesh is not None else ()
+
+    def _check_in_group_axis(self, name: str) -> None:
+        if name not in self._in_group_names():
+            raise KeyError(
+                f"unknown mesh axis {name!r} (have "
+                f"{(self.replica_axis,) + self._in_group_names()})"
+            )
 
     @property
     def axis_names(self) -> Tuple[str, ...]:
-        return tuple(self.mesh.axis_names)
+        return (self.replica_axis,) + self._in_group_names()
 
     def axis_size(self, name: str) -> int:
+        if name == self.replica_axis:
+            return self.num_replicas()
+        self._check_in_group_axis(name)
         return self.mesh.shape[name]
+
+    @property
+    def shape(self) -> Dict[str, int]:
+        out = {self.replica_axis: self.num_replicas()}
+        for n in self._in_group_names():
+            out[n] = self.mesh.shape[n]
+        return out
+
+    @property
+    def ndim(self) -> int:
+        return len(self.axis_names)
+
+    def size(self, name: Optional[str] = None) -> int:
+        if name is not None:
+            return self.axis_size(name)
+        total = 1
+        for s in self.shape.values():
+            total *= s
+        return total
 
     def num_replicas(self) -> int:
         """Size of the virtual replica axis = current quorum participants.
@@ -96,14 +171,92 @@ class FTMesh:
         per step right now."""
         return float(self.num_replicas())
 
+    # ----------------------------------------------------------- selection
+
+    def __getitem__(self, names):
+        """Sub-selection (ref ManagedDeviceMesh.__getitem__): selecting
+        the replica axis yields an FTMesh view restricted to the selected
+        in-group axes; selecting only in-group axes yields the name tuple
+        for use in PartitionSpecs / shard_map axis arguments."""
+        if isinstance(names, str):
+            names = (names,)
+        for n in names:
+            if n != self.replica_axis:
+                self._check_in_group_axis(n)
+        if self.replica_axis in names:
+            rest = tuple(n for n in names if n != self.replica_axis)
+            return FTMesh(
+                self.manager,
+                self.mesh if rest else None,
+                replica_axis=self.replica_axis,
+                selected=rest if rest else None,
+            )
+        return names if len(names) > 1 else names[0]
+
+    def get_comm(self, axis: Optional[str] = None):
+        """The get_group analog: what moves data across ``axis``.
+
+        Replica axis (or None) -> a ManagedCommContext routing through
+        the Manager (host transport over DCN, error-latching). In-group
+        axis -> the axis name itself: inside shard_map/pjit, collectives
+        over it are compiled jax.lax ops on ICI, not runtime objects."""
+        if axis is None or axis == self.replica_axis:
+            from torchft_tpu.comm.context import ManagedCommContext
+
+            return ManagedCommContext(self.manager)
+        self._check_in_group_axis(axis)
+        return axis
+
+    def flattened_spec(self, *names: str):
+        """PartitionSpec fragment sharding one array dimension over
+        several in-group axes (the _flatten analog): use as
+        P(ftmesh.flattened_spec("data", "fsdp"), None)."""
+        for n in names:
+            if n == self.replica_axis:
+                raise ValueError(
+                    "the replica axis is virtual and cannot appear in a "
+                    "PartitionSpec (it never exists in compiled programs)"
+                )
+            self._check_in_group_axis(n)
+        return tuple(names)
+
+    def coordinate(self, device=None) -> Dict[str, int]:
+        """Per-axis indices of ``device`` (default: first local device),
+        plus this replica group's rank on the virtual axis
+        (ref get_coordinate, :1243-1258)."""
+        import numpy as np
+
+        rank = self.manager.participating_rank()
+        out = {self.replica_axis: rank if rank is not None else 0}
+        if self.mesh is None:
+            return out
+        if device is None:
+            device = self.mesh.devices.flat[0]
+        idx = np.argwhere(self.mesh.devices == device)
+        if idx.size == 0:
+            raise ValueError(f"{device} is not part of the in-group mesh")
+        selected = self._in_group_names()
+        for name, i in zip(self.mesh.axis_names, idx[0]):
+            if name in selected:
+                out[name] = int(i)
+        return out
+
+    # ------------------------------------------------------------ shardings
+
     def sharding(self, *pspec) -> "jax.sharding.NamedSharding":
         import jax
         from jax.sharding import NamedSharding, PartitionSpec
 
+        if self.mesh is None:
+            raise ValueError(
+                "this FTMesh view has no in-group mesh (replica-only "
+                "selection); shardings need real mesh axes"
+            )
         return NamedSharding(self.mesh, PartitionSpec(*pspec))
 
     def __repr__(self) -> str:
+        inner = {n: self.mesh.shape[n] for n in self._in_group_names()}
         return (
-            f"FTMesh(in_group={dict(self.mesh.shape)}, "
+            f"FTMesh(in_group={inner}, "
             f"replicas~{self.num_replicas()})"
         )
