@@ -217,8 +217,12 @@ def main() -> None:
     n_replicas = int(os.environ.get("BENCH_REPLICAS", "2"))
     grad_step = make_grad_step(cfg, attn_fn=attn_fn)
 
+    # Snappy failure detection for the chaos phase (production uses the
+    # reference's 60s/5s defaults; a short bench window needs the kill
+    # disruption measured, not the detection interval).
     lighthouse = Lighthouse(
-        min_replicas=n_replicas, join_timeout_ms=2000
+        min_replicas=n_replicas, join_timeout_ms=500,
+        heartbeat_timeout_ms=800,
     )
     store = StoreServer()
     params_ft = init_params(cfg, key)
@@ -263,53 +267,75 @@ def main() -> None:
         ]
         echo_stop = threading.Event()
 
+        chaos_kill = threading.Event()  # chaos phase: kill one echo
+        chaos_kill_ack = threading.Event()  # echo observed the kill
+
         def _echo_replica(idx: int, echo_store) -> None:
-            try:
-                state = {"x": np.zeros(1, np.float32)}
-                mgr2 = Manager(
-                    comm=TcpCommContext(timeout=60.0),
-                    load_state_dict=lambda sd: state.update(sd),
-                    state_dict=lambda: dict(state),
-                    min_replica_size=1,
-                    rank=0,
-                    world_size=1,
-                    store_addr=echo_store.addr,
-                    lighthouse_addr=lighthouse.address(),
-                    replica_id=f"bench{idx}_",
-                    timeout=60.0,
-                    quorum_timeout=60.0,
-                    connect_timeout=60.0,
-                )
-            except Exception as e:  # noqa: BLE001
-                sys.stderr.write(f"bench: echo replica {idx} failed to "
-                                 f"start: {e}\n")
+            # Outer loop = one manager lifetime; a chaos kill tears the
+            # manager down (closing its transport sockets mid-collective,
+            # exactly like a dead host) and rejoins after a dead time.
+            while not echo_stop.is_set():
+                try:
+                    state = {"x": np.zeros(1, np.float32)}
+                    mgr2 = Manager(
+                        comm=TcpCommContext(timeout=60.0),
+                        load_state_dict=lambda sd: state.update(sd),
+                        state_dict=lambda: dict(state),
+                        min_replica_size=1,
+                        rank=0,
+                        world_size=1,
+                        store_addr=echo_store.addr,
+                        lighthouse_addr=lighthouse.address(),
+                        replica_id=f"bench{idx}_",
+                        timeout=60.0,
+                        quorum_timeout=60.0,
+                        connect_timeout=60.0,
+                    )
+                except Exception as e:  # noqa: BLE001
+                    sys.stderr.write(f"bench: echo replica {idx} failed "
+                                     f"to start: {e}\n")
+                    return
+                killed = False
+                try:
+                    while not echo_stop.is_set():
+                        if idx == 1 and chaos_kill.is_set():
+                            chaos_kill.clear()
+                            chaos_kill_ack.set()
+                            killed = True
+                            sys.stderr.write(
+                                f"bench: chaos-killing echo {idx}\n"
+                            )
+                            break
+                        try:
+                            # allow_heal=False: the echo replica must
+                            # never pull the main replica's full model
+                            # state at bootstrap
+                            mgr2.start_quorum(allow_heal=False)
+                            works = [
+                                mgr2.allreduce_arrays([b.copy()])
+                                for b in zero_buckets
+                            ]
+                            for w in works:
+                                w.future().result(timeout=60)
+                            mgr2.should_commit()
+                        except Exception as e:  # noqa: BLE001 — any
+                            # transport hiccup: keep the quorum population
+                            # alive, the bench depends on this replica
+                            if echo_stop.is_set():
+                                return
+                            sys.stderr.write(
+                                f"bench: echo {idx} step retry: {e}\n"
+                            )
+                            # backoff: never spin-burn CPU on the machine
+                            # whose throughput is being measured
+                            echo_stop.wait(0.2)
+                finally:
+                    mgr2.shutdown(wait=False)
+                if killed:
+                    # stay dead past the heartbeat timeout, then rejoin
+                    echo_stop.wait(2.5)
+                    continue
                 return
-            try:
-                while not echo_stop.is_set():
-                    try:
-                        # allow_heal=False: the echo replica must never pull
-                        # the main replica's full model state at bootstrap
-                        mgr2.start_quorum(allow_heal=False)
-                        works = [
-                            mgr2.allreduce_arrays([b.copy()])
-                            for b in zero_buckets
-                        ]
-                        for w in works:
-                            w.future().result(timeout=60)
-                        mgr2.should_commit()
-                    except Exception as e:  # noqa: BLE001 — any transport
-                        # hiccup: keep the quorum population alive, the
-                        # bench depends on this replica existing
-                        if echo_stop.is_set():
-                            return
-                        sys.stderr.write(
-                            f"bench: echo {idx} step retry: {e}\n"
-                        )
-                        # backoff: never spin-burn CPU on the machine
-                        # whose throughput is being measured
-                        echo_stop.wait(0.2)
-            finally:
-                mgr2.shutdown(wait=False)
 
         for idx in range(1, n_replicas):
             echo_store = StoreServer()
@@ -324,6 +350,7 @@ def main() -> None:
 
     committed = 0
     attempted = 0
+    world_seen = []  # quorum membership per step (solo-dip detection)
 
     def ft_step():
         nonlocal committed, attempted
@@ -340,6 +367,7 @@ def main() -> None:
             committed += 1
             opt_state_holder["params"] = p
             opt_state_holder["opt"] = s
+        world_seen.append(manager.replica_world_size())
         return loss
 
     # Bring-up gate: the first warmup step doubles as proof that the
@@ -377,12 +405,59 @@ def main() -> None:
     for _ in range(warmup - 1):
         loss = ft_step()
     jax.block_until_ready(loss)
+    t1_window_start = len(world_seen)
     t_start = time.perf_counter()
     for _ in range(steps):
         loss = ft_step()
     jax.block_until_ready(loss)
     t1_elapsed = time.perf_counter() - t_start
     t1 = tokens_per_step * steps / t1_elapsed
+    # A quorum that shrank mid-window means some steps rode the
+    # solo fast path; report the dip so T1 can't silently overstate
+    # multi-replica throughput.
+    t1_min_world = min(world_seen[t1_window_start:]) if steps else 0
+
+    # ---- T2: FT loop under chaos (the north-star scenario) -------------
+    # Kill one echo replica mid-window; it closes its sockets
+    # mid-collective (dead-host semantics), the quorum shrinks, the main
+    # replica keeps committing, and the echo rejoins a few seconds later.
+    # Throughput counts COMMITTED tokens only.
+    chaos = (
+        os.environ.get("BENCH_CHAOS", "1") != "0" and n_replicas >= 2
+    )
+    t2 = chaos_commit_rate = None
+    chaos_seconds = float(os.environ.get("BENCH_CHAOS_SECONDS", "15"))
+    if chaos:
+        committed_before, attempted_before = committed, attempted
+        t_start = time.perf_counter()
+        kill_at = t_start + chaos_seconds / 4
+        killed_once = False
+        while time.perf_counter() - t_start < chaos_seconds:
+            if not killed_once and time.perf_counter() >= kill_at:
+                chaos_kill.set()
+                killed_once = True
+            loss = ft_step()
+        jax.block_until_ready(loss)
+        t2_elapsed = time.perf_counter() - t_start
+        if not (killed_once and chaos_kill_ack.wait(timeout=1.0)):
+            # no kill actually landed (echo already dead, or a single
+            # step outlasted the window): chaos numbers would measure a
+            # fault-free window — don't report them as chaos
+            sys.stderr.write(
+                "bench: chaos kill never landed; chaos metrics omitted\n"
+            )
+            chaos = False
+            t2 = None
+        else:
+            chaos_committed = committed - committed_before
+            chaos_attempted = attempted - attempted_before
+            t2 = tokens_per_step * chaos_committed / t2_elapsed
+            chaos_commit_rate = chaos_committed / max(1, chaos_attempted)
+            # == n_replicas proves the killed echo rejoined inside the
+            # window (quorum membership; the zero-grad echo deliberately
+            # stays behind the max-step cohort, so num_participants
+            # would not count it)
+            chaos_participants_end = manager.replica_world_size()
 
     if echo_stop is not None:
         echo_stop.set()
@@ -420,6 +495,22 @@ def main() -> None:
                     None if flash_err != flash_err else flash_err
                 ),
                 "commit_rate": committed / max(1, attempted),
+                "t1_min_replica_world": t1_min_world,
+                "chaos_tokens_per_sec": (
+                    None if t2 is None else round(t2, 1)
+                ),
+                "chaos_efficiency": (
+                    None if t2 is None else round(t2 / t0, 4)
+                ),
+                "chaos_commit_rate": chaos_commit_rate,
+                # one kill per window; the north-star cadence is 1/min,
+                # so short windows over-weight the disruption
+                "chaos_kills_per_min": (
+                    None if t2 is None else round(60.0 / chaos_seconds, 1)
+                ),
+                "chaos_participants_end": (
+                    None if t2 is None else chaos_participants_end
+                ),
                 "replicas": n_replicas,
                 "model": model_name,
                 "params_m": round(n_params / 1e6, 1),
