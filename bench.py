@@ -220,8 +220,12 @@ def main() -> None:
     # Snappy failure detection for the chaos phase (production uses the
     # reference's 60s/5s defaults; a short bench window needs the kill
     # disruption measured, not the detection interval).
+    # min_replicas=1: the whole point of the chaos phase is that the
+    # quorum SHRINKS and the survivor keeps committing when a replica
+    # dies (a floor of n would instead stall until rejoin). The bring-up
+    # gate below still guarantees T1 starts with all n replicas joined.
     lighthouse = Lighthouse(
-        min_replicas=n_replicas, join_timeout_ms=500,
+        min_replicas=1, join_timeout_ms=500,
         heartbeat_timeout_ms=800,
     )
     store = StoreServer()
@@ -370,13 +374,19 @@ def main() -> None:
         world_seen.append(manager.replica_world_size())
         return loss
 
-    # Bring-up gate: the first warmup step doubles as proof that the
-    # n-replica FT loop actually commits (same per-round op sequence as
-    # the echoes, so no desync). If it can't — an echo died, port
-    # conflicts — re-run solo rather than emitting garbage labelled
-    # replicas=N.
+    # Bring-up gate: step until the FULL n-replica quorum has formed and
+    # committed (early rounds may be solo while echoes join). If it never
+    # does — an echo died, port conflicts — re-run solo rather than
+    # emitting garbage labelled replicas=N.
     loss = ft_step()
-    if n_replicas >= 2 and committed == 0:
+    bringup_deadline = time.perf_counter() + 30.0
+    while (
+        n_replicas >= 2
+        and world_seen[-1] < n_replicas
+        and time.perf_counter() < bringup_deadline
+    ):
+        loss = ft_step()
+    if n_replicas >= 2 and (committed == 0 or world_seen[-1] < n_replicas):
         alive = sum(t.is_alive() for t in echo_threads)
         sys.stderr.write(
             f"bench: {n_replicas}-replica first step failed to commit "
@@ -439,7 +449,9 @@ def main() -> None:
             loss = ft_step()
         jax.block_until_ready(loss)
         t2_elapsed = time.perf_counter() - t_start
-        if not (killed_once and chaos_kill_ack.wait(timeout=1.0)):
+        if not (killed_once and chaos_kill_ack.is_set()):
+            # ack must land INSIDE the window — a late ack would mean the
+            # measured window was fault-free
             # no kill actually landed (echo already dead, or a single
             # step outlasted the window): chaos numbers would measure a
             # fault-free window — don't report them as chaos

@@ -581,3 +581,93 @@ def test_chaos_multi_rank_groups_kill_and_heal() -> None:
                 err_msg=f"rank {r} divergence at step {s}",
             )
     assert overlapping >= 4
+
+
+def test_recovery_with_compressed_multilane_transport() -> None:
+    # Compose the round-2 transport features with the FT loop: bf16 wire
+    # compression + 4 lanes, kill a replica, heal, trajectory oracle.
+    # Lossy compression must not break bitwise cross-replica consistency
+    # (encoded bytes are fanned out verbatim) nor any heal path.
+    lighthouse = Lighthouse(
+        min_replicas=1, join_timeout_ms=200, heartbeat_timeout_ms=1000
+    )
+    harness = Harness(2, 6)
+    injectors = [FailureInjector().fail_at(0, 2), FailureInjector()]
+
+    class CompressedRunner(Runner):
+        def _replica_main(self) -> None:
+            store = StoreServer()
+            state = {"w": np.zeros((2, 3), dtype=np.float32)}
+
+            def load_state_dict(sd):
+                state["w"] = np.array(sd["w"], dtype=np.float32)
+
+            manager = Manager(
+                comm=TcpCommContext(
+                    timeout=5.0, algorithm="star", channels=4,
+                    compression="bf16",
+                ),
+                load_state_dict=load_state_dict,
+                state_dict=lambda: {"w": state["w"]},
+                min_replica_size=1,
+                use_async_quorum=True,
+                timeout=5.0, quorum_timeout=5.0, connect_timeout=5.0,
+                rank=0, world_size=1,
+                store_addr=store.addr,
+                lighthouse_addr=self.lighthouse_addr,
+                replica_id=f"creplica_{self.replica_id}_",
+                heartbeat_interval=0.05,
+            )
+            try:
+                while not self.harness.stop.is_set():
+                    self.failure_injector.check(0, manager.current_step())
+                    try:
+                        manager.start_quorum()
+                        grad = state["w"] - self.target
+                        fut = manager.allreduce_arrays([grad]).future()
+                        avg = fut.result(timeout=20)[0]
+                        committed = manager.should_commit()
+                    except (TimeoutError, RuntimeError) as e:
+                        logger.info("step retry: %s", e)
+                        continue
+                    if committed:
+                        state["w"] = state["w"] - self.lr * avg
+                        self.history[manager.current_step()] = np.array(
+                            state["w"]
+                        )
+                        self.harness.report(
+                            self.replica_id, manager.current_step()
+                        )
+                    else:
+                        time.sleep(0.01)
+            finally:
+                manager.shutdown(wait=False)
+                store.shutdown()
+
+    runners = [
+        CompressedRunner(i, lighthouse.address(), injectors[i], harness)
+        for i in range(2)
+    ]
+    try:
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            futs = [pool.submit(r.run_replica) for r in runners]
+            for f in futs:
+                f.result(timeout=90)
+    finally:
+        harness.stop.set()
+        lighthouse.shutdown()
+
+    assert injectors[0].count == 1
+    # bitwise oracle: bf16-compressed averages must still be identical
+    # across replicas (not merely close)
+    all_steps = {}
+    for r in runners:
+        for step, w in r.history.items():
+            all_steps.setdefault(step, []).append(w)
+    overlapping = [ws for ws in all_steps.values() if len(ws) > 1]
+    assert len(overlapping) >= 3
+    for ws in overlapping:
+        for w in ws[1:]:
+            np.testing.assert_array_equal(w, ws[0])
+    for r in runners:
+        assert max(r.history) >= 6

@@ -236,3 +236,63 @@ def test_pipeline_1f1b_matches_sequential_grads() -> None:
         ),
         grads, ref_g,
     )
+
+
+def test_pipeline_interleaved_1f1b_matches_sequential_grads() -> None:
+    from torchft_tpu.parallel import ft_mesh, split_microbatches
+    from torchft_tpu.parallel.pipeline import (
+        make_pipeline_interleaved_1f1b,
+        stack_interleaved_params,
+    )
+
+    S, M, V, mb_size, d = 4, 8, 2, 2, 6
+    mesh = ft_mesh({"stage": S}, devices=jax.devices()[:S])
+    rng = np.random.default_rng(5)
+    virtual_params = [
+        {"w": jnp.asarray(rng.standard_normal((d, d)), jnp.float32) * 0.35}
+        for _ in range(S * V)
+    ]
+    x = jnp.asarray(rng.standard_normal((M * mb_size, d)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((M * mb_size, d)), jnp.float32)
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def loss_fn(h, y_mb):
+        return jnp.mean((h - y_mb) ** 2)
+
+    pp = make_pipeline_interleaved_1f1b(
+        mesh, stage_fn, loss_fn, num_microbatches=M, interleave=V
+    )
+    stacked = stack_interleaved_params(virtual_params, S, V)
+    loss, grads = jax.jit(pp)(
+        stacked, split_microbatches(x, M), split_microbatches(y, M)
+    )
+
+    # sequential reference over all V*S virtual stages, in v order
+    def ref_loss(stacked_p):
+        # stacked_p rows are device-major: row s*V + c = virtual c*S + s
+        def virt(v):
+            s, c = v % S, v // S
+            return jax.tree_util.tree_map(
+                lambda l: l[s * V + c], stacked_p
+            )
+
+        total = 0.0
+        xm = split_microbatches(x, M)
+        ym = split_microbatches(y, M)
+        for k in range(M):
+            h = xm[k]
+            for v in range(S * V):
+                h = stage_fn(virt(v), h)
+            total = total + loss_fn(h, ym[k])
+        return total / M
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(stacked)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        grads, ref_g,
+    )

@@ -27,14 +27,17 @@ from torchft_tpu.parallel.moe import (  # noqa: F401
 from torchft_tpu.parallel.pipeline import (  # noqa: F401
     make_pipeline,
     make_pipeline_1f1b,
+    make_pipeline_interleaved_1f1b,
     merge_microbatches,
     split_microbatches,
+    stack_interleaved_params,
     stack_stage_params,
 )
 from torchft_tpu.parallel.schedule import (  # noqa: F401
     bubble_fraction,
     gpipe_schedule,
     interleaved_1f1b_schedule,
+    interleaved_tables,
     one_f_one_b_schedule,
     peak_inflight_activations,
     validate_schedule,

@@ -23,8 +23,10 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional
 
-__all__ = ["make_pipeline", "make_pipeline_1f1b", "stack_stage_params",
-           "split_microbatches", "merge_microbatches"]
+__all__ = ["make_pipeline", "make_pipeline_1f1b",
+           "make_pipeline_interleaved_1f1b", "stack_stage_params",
+           "stack_interleaved_params", "split_microbatches",
+           "merge_microbatches"]
 
 
 def stack_stage_params(stage_params_list) -> Any:
@@ -36,6 +38,26 @@ def stack_stage_params(stage_params_list) -> Any:
 
     return jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves), *stage_params_list
+    )
+
+
+def stack_interleaved_params(stage_params_list, num_stages: int,
+                             interleave: int):
+    """Stack V*S virtual-stage param pytrees (virtual-stage order: list
+    index v, where v = chunk*S + device) into one pytree with a leading
+    [S*V] dim in DEVICE-MAJOR order (row s*V + c = virtual stage c*S + s),
+    so sharding the leading dim over the stage axis hands device s exactly
+    its V chunks, c-indexed."""
+    import jax
+    import jax.numpy as jnp
+
+    S, V = num_stages, interleave
+    assert len(stage_params_list) == S * V, (len(stage_params_list), S * V)
+    device_major = [
+        stage_params_list[c * S + s] for s in range(S) for c in range(V)
+    ]
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *device_major
     )
 
 
@@ -294,6 +316,212 @@ def make_pipeline_1f1b(mesh, stage_fn: Callable[[Any, Any], Any],
         pgrads = jax.tree_util.tree_map(
             lambda l: (l / M)[None], pgrads
         )
+        return mean_loss, pgrads
+
+    return shard_map(
+        _body,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P()),
+        out_specs=(P(), P(axis)),
+        **check_kwargs,
+    )
+
+
+def make_pipeline_interleaved_1f1b(
+    mesh, stage_fn: Callable[[Any, Any], Any],
+    loss_fn: Callable[[Any, Any], Any],
+    num_microbatches: int,
+    interleave: int,
+    axis: str = "stage",
+    embed_fn: Optional[Callable[[Any], Any]] = None,
+):
+    """Executable interleaved 1F1B (Megatron-style virtual stages):
+    (stacked_params, x_mb, y_mb) -> (mean_loss, stacked_param_grads).
+
+    Each device owns ``interleave`` model chunks (params stacked
+    device-major via stack_interleaved_params); microbatches traverse all
+    V*S virtual stages, which maps onto the physical ring because
+    virtual-stage hop v -> v+1 is always device s -> (s+1) % S. The greedy
+    interleaved schedule does not align a producer's send with its
+    consumer's fire tick, so values park in per-device buffers whose slot
+    assignments are computed statically (schedule.interleaved_tables) and
+    driven by per-tick index tables inside the fori_loop. Bubble fraction
+    drops toward (S-1)/V of GPipe's (see schedule.py); in exchange every
+    microbatch makes V times the p2p hops.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from torchft_tpu.parallel.schedule import interleaved_tables
+
+    shard_map, check_kwargs = _get_shard_map()
+    S = mesh.shape[axis]
+    M = num_microbatches
+    V = interleave
+
+    tbl = interleaved_tables(S, M, V)
+    T = tbl["ticks"]
+    names = ("f_mb", "f_chunk", "f_src", "f_act", "f_stash",
+             "b_mb", "b_chunk", "b_act", "b_gsrc", "b_stash")
+    np_tables = {
+        n: np.asarray(tbl[n], np.int32) for n in names
+    }
+
+    def _body(stacked_params, x, y):
+        stage = lax.axis_index(axis)
+        # local slice after shard_map: [V, ...] per leaf (device-major)
+        params = stacked_params
+        assert x.shape[0] == M, (x.shape, M)
+
+        def _embed(mb):
+            return embed_fn(mb) if embed_fn is not None else mb
+
+        hidden_sds = jax.eval_shape(_embed, jax.eval_shape(lambda: x[0]))
+        zeros_hidden = jnp.zeros(hidden_sds.shape, hidden_sds.dtype)
+        tabs = {n: jnp.asarray(a) for n, a in np_tables.items()}
+
+        def pick_chunk(c):
+            return jax.tree_util.tree_map(
+                lambda l: lax.dynamic_index_in_dim(
+                    l, c, axis=0, keepdims=False
+                ),
+                params,
+            )
+
+        zero_chunk_grads = jax.tree_util.tree_map(
+            lambda l: jnp.zeros(l.shape[1:], l.dtype), params
+        )
+        perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+        perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+
+        def tick(t, carry):
+            (h_chan, g_chan, fwd_buf, bwd_buf, acts, pgrads,
+             loss_acc) = carry
+
+            def cell(name):
+                return lax.dynamic_index_in_dim(
+                    lax.dynamic_index_in_dim(
+                        tabs[name], t, axis=0, keepdims=False
+                    ),
+                    stage, axis=0, keepdims=False,
+                )
+
+            f_mb, f_chunk, f_src, f_act, f_stash = (
+                cell("f_mb"), cell("f_chunk"), cell("f_src"),
+                cell("f_act"), cell("f_stash"),
+            )
+            b_mb, b_chunk, b_act, b_gsrc, b_stash = (
+                cell("b_mb"), cell("b_chunk"), cell("b_act"),
+                cell("b_gsrc"), cell("b_stash"),
+            )
+
+            # ---- stash arriving channel values -----------------------
+            def masked_store(buf, slot, value):
+                idx = jnp.clip(slot, 0, buf.shape[0] - 1)
+                current = lax.dynamic_index_in_dim(
+                    buf, idx, axis=0, keepdims=False
+                )
+                return lax.dynamic_update_index_in_dim(
+                    buf, jnp.where(slot >= 0, value, current), idx, axis=0,
+                )
+
+            fwd_buf = masked_store(fwd_buf, f_stash, h_chan)
+            bwd_buf = masked_store(bwd_buf, b_stash, g_chan)
+
+            # ---- forward slot ----------------------------------------
+            mb_in = lax.dynamic_index_in_dim(
+                x, jnp.clip(f_mb, 0, M - 1), axis=0, keepdims=False
+            )
+            src = lax.dynamic_index_in_dim(
+                fwd_buf, jnp.clip(f_src, 0, fwd_buf.shape[0] - 1),
+                axis=0, keepdims=False,
+            )
+            h_in = jnp.where(f_src < 0, _embed(mb_in), src)
+            p_f = pick_chunk(jnp.clip(f_chunk, 0, V - 1))
+            h_out = lax.cond(
+                f_mb >= 0,
+                lambda _: stage_fn(p_f, h_in),
+                lambda _: zeros_hidden,
+                operand=None,
+            )
+            acts = masked_store(acts, f_act, h_in)
+
+            # ---- backward slot ---------------------------------------
+            a_in = lax.dynamic_index_in_dim(
+                acts, jnp.clip(b_act, 0, acts.shape[0] - 1),
+                axis=0, keepdims=False,
+            )
+            y_mb = lax.dynamic_index_in_dim(
+                y, jnp.clip(b_mb, 0, M - 1), axis=0, keepdims=False
+            )
+            g_in = lax.dynamic_index_in_dim(
+                bwd_buf, jnp.clip(b_gsrc, 0, bwd_buf.shape[0] - 1),
+                axis=0, keepdims=False,
+            )
+            p_b = pick_chunk(jnp.clip(b_chunk, 0, V - 1))
+
+            def do_bwd(_):
+                def last_virtual(_):
+                    def fwd_loss(p, a):
+                        return loss_fn(stage_fn(p, a), y_mb)
+
+                    loss_k, vjp = jax.vjp(fwd_loss, p_b, a_in)
+                    pg, ag = vjp(jnp.ones_like(loss_k))
+                    return loss_k, pg, ag
+
+                def mid_virtual(_):
+                    _, vjp = jax.vjp(stage_fn, p_b, a_in)
+                    pg, ag = vjp(g_in)
+                    return jnp.zeros(()), pg, ag
+
+                return lax.cond(
+                    b_gsrc < 0, last_virtual, mid_virtual, operand=None
+                )
+
+            def no_bwd(_):
+                return jnp.zeros(()), zero_chunk_grads, zeros_hidden
+
+            loss_k, pg, ag = lax.cond(b_mb >= 0, do_bwd, no_bwd,
+                                      operand=None)
+            c_idx = jnp.clip(b_chunk, 0, V - 1)
+            pgrads = jax.tree_util.tree_map(
+                lambda acc, g: lax.dynamic_update_index_in_dim(
+                    acc,
+                    lax.dynamic_index_in_dim(
+                        acc, c_idx, axis=0, keepdims=False
+                    ) + g,
+                    c_idx, axis=0,
+                ),
+                pgrads, pg,
+            )
+            loss_acc = loss_acc + loss_k
+
+            h_chan = lax.ppermute(h_out, axis, perm_fwd)
+            g_chan = lax.ppermute(ag, axis, perm_bwd)
+            return (h_chan, g_chan, fwd_buf, bwd_buf, acts, pgrads,
+                    loss_acc)
+
+        fwd_buf0 = jnp.zeros(
+            (tbl["n_fwd_slots"],) + hidden_sds.shape, hidden_sds.dtype
+        )
+        bwd_buf0 = jnp.zeros(
+            (tbl["n_bwd_slots"],) + hidden_sds.shape, hidden_sds.dtype
+        )
+        acts0 = jnp.zeros(
+            (tbl["n_act_slots"],) + hidden_sds.shape, hidden_sds.dtype
+        )
+        pgrads0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+        carry0 = (zeros_hidden, zeros_hidden, fwd_buf0, bwd_buf0, acts0,
+                  pgrads0, jnp.zeros(()))
+        out = lax.fori_loop(0, T, tick, carry0)
+        pgrads, loss_acc = out[5], out[6]
+
+        mean_loss = lax.psum(loss_acc, axis) / M
+        pgrads = jax.tree_util.tree_map(lambda l: l / M, pgrads)
         return mean_loss, pgrads
 
     return shard_map(

@@ -26,6 +26,7 @@ __all__ = [
     "bubble_fraction",
     "peak_inflight_activations",
     "validate_schedule",
+    "interleaved_tables",
 ]
 
 Action = Optional[Tuple[str, int, int]]  # (phase, microbatch, chunk)
@@ -198,3 +199,133 @@ def validate_schedule(
             assert b_ticks[(s2, mb, c2)] > t, (
                 f"B dependency violated at mb={mb} v={v}"
             )
+
+
+def _alloc_slots(intervals):
+    """First-fit interval slot allocation: intervals = {key: (start, end)}
+    inclusive; returns ({key: slot}, num_slots). Keys whose intervals
+    overlap get distinct slots."""
+    order = sorted(intervals, key=lambda k: intervals[k][0])
+    slot_free_at: List[int] = []  # slot -> first tick it is free again
+    assignment = {}
+    for key in order:
+        start, end = intervals[key]
+        for slot, free_at in enumerate(slot_free_at):
+            if free_at <= start:
+                assignment[key] = slot
+                slot_free_at[slot] = end + 1
+                break
+        else:
+            assignment[key] = len(slot_free_at)
+            slot_free_at.append(end + 1)
+    return assignment, len(slot_free_at)
+
+
+def interleaved_tables(num_stages: int, num_microbatches: int,
+                       interleave: int):
+    """Lower an interleaved-1F1B schedule into static per-tick tables for
+    the executable runner (pipeline.make_pipeline_interleaved_1f1b).
+
+    The greedy schedule does not align a virtual stage's send with its
+    consumer's fire tick, so inter-stage values park in per-device
+    buffers; this computes a static slot assignment (interval first-fit)
+    for the forward-value buffers, the backward-cotangent buffers, and
+    the saved-activation buffers.
+
+    Returns a dict of int arrays shaped [T, S] (value -1 = no-op):
+      f_mb, f_chunk      microbatch/chunk of this tick's forward
+      f_src              fwd-buffer slot holding the stage input
+                         (-1 = virtual stage 0: embed from x)
+      f_act              activation slot to SAVE the stage input into
+      f_stash            fwd-buffer slot for the value ARRIVING this tick
+      b_mb, b_chunk      microbatch/chunk of this tick's backward
+      b_act              activation slot holding the saved stage input
+      b_gsrc             bwd-buffer slot holding the cotangent
+                         (-1 = last virtual stage: seed from the loss)
+      b_stash            bwd-buffer slot for the cotangent arriving now
+    plus scalars n_fwd_slots, n_bwd_slots, n_act_slots, ticks.
+    """
+    S, M, V = num_stages, num_microbatches, interleave
+    total_v = V * S
+    sched = interleaved_1f1b_schedule(S, M, V)
+    T = len(sched)
+
+    t_f: dict = {}
+    t_b: dict = {}
+    for t, row in enumerate(sched):
+        for s, a in enumerate(row):
+            if a is None:
+                continue
+            phase, mb, chunk = a
+            v = chunk * S + s
+            (t_f if phase == "F" else t_b)[(v, mb)] = t
+
+    # Buffer intervals, per receiving device. fwd edge (v, k) -> (v+1, k):
+    # value leaves device v%S at t_f[(v,k)], arrives at (v+1)%S one tick
+    # later, is consumed at t_f[(v+1,k)].
+    fwd_intervals: List[dict] = [dict() for _ in range(S)]
+    bwd_intervals: List[dict] = [dict() for _ in range(S)]
+    act_intervals: List[dict] = [dict() for _ in range(S)]
+    for (v, k), tf in t_f.items():
+        if v + 1 < total_v:
+            dst = (v + 1) % S
+            fwd_intervals[dst][(v + 1, k)] = (tf + 1, t_f[(v + 1, k)])
+        act_intervals[v % S][(v, k)] = (tf, t_b[(v, k)])
+    for (v, k), tb in t_b.items():
+        if v - 1 >= 0:
+            dst = (v - 1) % S
+            bwd_intervals[dst][(v - 1, k)] = (tb + 1, t_b[(v - 1, k)])
+
+    fwd_slots = [
+        _alloc_slots(fwd_intervals[s]) for s in range(S)
+    ]
+    bwd_slots = [
+        _alloc_slots(bwd_intervals[s]) for s in range(S)
+    ]
+    act_slots = [
+        _alloc_slots(act_intervals[s]) for s in range(S)
+    ]
+
+    def table():
+        return [[-1] * S for _ in range(T)]
+
+    out = {name: table() for name in (
+        "f_mb", "f_chunk", "f_src", "f_act", "f_stash",
+        "b_mb", "b_chunk", "b_act", "b_gsrc", "b_stash",
+    )}
+    for t, row in enumerate(sched):
+        for s, a in enumerate(row):
+            if a is None:
+                continue
+            phase, mb, chunk = a
+            v = chunk * S + s
+            if phase == "F":
+                out["f_mb"][t][s] = mb
+                out["f_chunk"][t][s] = chunk
+                out["f_src"][t][s] = (
+                    -1 if v == 0 else fwd_slots[s][0][(v, mb)]
+                )
+                out["f_act"][t][s] = act_slots[s][0][(v, mb)]
+            else:
+                out["b_mb"][t][s] = mb
+                out["b_chunk"][t][s] = chunk
+                out["b_act"][t][s] = act_slots[s][0][(v, mb)]
+                out["b_gsrc"][t][s] = (
+                    -1 if v == total_v - 1 else bwd_slots[s][0][(v, mb)]
+                )
+    # Stash tables: a value arriving at tick t on device s was produced at
+    # t-1 on the neighbor; park it in the slot its consumer will read.
+    for (v, k), tf in t_f.items():
+        if v + 1 < total_v:
+            dst = (v + 1) % S
+            out["f_stash"][tf + 1][dst] = fwd_slots[dst][0][(v + 1, k)]
+    for (v, k), tb in t_b.items():
+        if v - 1 >= 0:
+            dst = (v - 1) % S
+            out["b_stash"][tb + 1][dst] = bwd_slots[dst][0][(v - 1, k)]
+
+    out["n_fwd_slots"] = max(1, max(n for _, n in fwd_slots))
+    out["n_bwd_slots"] = max(1, max(n for _, n in bwd_slots))
+    out["n_act_slots"] = max(1, max(n for _, n in act_slots))
+    out["ticks"] = T
+    return out
