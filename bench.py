@@ -416,12 +416,18 @@ def main() -> None:
         loss = ft_step()
     jax.block_until_ready(loss)
     t1_window_start = len(world_seen)
+    # commit_rate must describe the MEASURED window, not the (variable-
+    # length) bring-up steps
+    t1_committed_before, t1_attempted_before = committed, attempted
     t_start = time.perf_counter()
     for _ in range(steps):
         loss = ft_step()
     jax.block_until_ready(loss)
     t1_elapsed = time.perf_counter() - t_start
     t1 = tokens_per_step * steps / t1_elapsed
+    t1_commit_rate = (committed - t1_committed_before) / max(
+        1, attempted - t1_attempted_before
+    )
     # A quorum that shrank mid-window means some steps rode the
     # solo fast path; report the dip so T1 can't silently overstate
     # multi-replica throughput.
@@ -506,7 +512,7 @@ def main() -> None:
                 "flash_max_err": (
                     None if flash_err != flash_err else flash_err
                 ),
-                "commit_rate": committed / max(1, attempted),
+                "commit_rate": t1_commit_rate,
                 "t1_min_replica_world": t1_min_world,
                 "chaos_tokens_per_sec": (
                     None if t2 is None else round(t2, 1)

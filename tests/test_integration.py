@@ -92,6 +92,8 @@ class Runner:
         harness: Harness,
         target: Optional[np.ndarray] = None,
         lr: float = 0.5,
+        comm_kwargs: Optional[dict] = None,
+        replica_prefix: str = "replica",
     ) -> None:
         self.replica_id = replica_id
         self.lighthouse_addr = lighthouse_addr
@@ -99,6 +101,8 @@ class Runner:
         self.harness = harness
         self.target = target if target is not None else np.full((2, 3), 10.0)
         self.lr = lr
+        self.comm_kwargs = {"timeout": 5.0, **(comm_kwargs or {})}
+        self.replica_prefix = replica_prefix
         # committed step -> post-update weights
         self.history: Dict[int, np.ndarray] = {}
 
@@ -123,7 +127,7 @@ class Runner:
             state["w"] = np.array(sd["w"], dtype=np.float32)
 
         manager = Manager(
-            comm=TcpCommContext(timeout=5.0),
+            comm=TcpCommContext(**self.comm_kwargs),
             load_state_dict=load_state_dict,
             state_dict=lambda: {"w": state["w"]},
             min_replica_size=1,
@@ -135,7 +139,7 @@ class Runner:
             world_size=1,
             store_addr=store.addr,
             lighthouse_addr=self.lighthouse_addr,
-            replica_id=f"replica_{self.replica_id}_",
+            replica_id=f"{self.replica_prefix}_{self.replica_id}_",
             heartbeat_interval=0.05,
         )
         try:
@@ -594,58 +598,14 @@ def test_recovery_with_compressed_multilane_transport() -> None:
     harness = Harness(2, 6)
     injectors = [FailureInjector().fail_at(0, 2), FailureInjector()]
 
-    class CompressedRunner(Runner):
-        def _replica_main(self) -> None:
-            store = StoreServer()
-            state = {"w": np.zeros((2, 3), dtype=np.float32)}
-
-            def load_state_dict(sd):
-                state["w"] = np.array(sd["w"], dtype=np.float32)
-
-            manager = Manager(
-                comm=TcpCommContext(
-                    timeout=5.0, algorithm="star", channels=4,
-                    compression="bf16",
-                ),
-                load_state_dict=load_state_dict,
-                state_dict=lambda: {"w": state["w"]},
-                min_replica_size=1,
-                use_async_quorum=True,
-                timeout=5.0, quorum_timeout=5.0, connect_timeout=5.0,
-                rank=0, world_size=1,
-                store_addr=store.addr,
-                lighthouse_addr=self.lighthouse_addr,
-                replica_id=f"creplica_{self.replica_id}_",
-                heartbeat_interval=0.05,
-            )
-            try:
-                while not self.harness.stop.is_set():
-                    self.failure_injector.check(0, manager.current_step())
-                    try:
-                        manager.start_quorum()
-                        grad = state["w"] - self.target
-                        fut = manager.allreduce_arrays([grad]).future()
-                        avg = fut.result(timeout=20)[0]
-                        committed = manager.should_commit()
-                    except (TimeoutError, RuntimeError) as e:
-                        logger.info("step retry: %s", e)
-                        continue
-                    if committed:
-                        state["w"] = state["w"] - self.lr * avg
-                        self.history[manager.current_step()] = np.array(
-                            state["w"]
-                        )
-                        self.harness.report(
-                            self.replica_id, manager.current_step()
-                        )
-                    else:
-                        time.sleep(0.01)
-            finally:
-                manager.shutdown(wait=False)
-                store.shutdown()
-
     runners = [
-        CompressedRunner(i, lighthouse.address(), injectors[i], harness)
+        Runner(
+            i, lighthouse.address(), injectors[i], harness,
+            comm_kwargs={
+                "algorithm": "star", "channels": 4, "compression": "bf16",
+            },
+            replica_prefix="creplica",
+        )
         for i in range(2)
     ]
     try:

@@ -2,6 +2,7 @@
 differentiability through the pipeline, microbatch helpers."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -296,3 +297,77 @@ def test_pipeline_interleaved_1f1b_matches_sequential_grads() -> None:
         ),
         grads, ref_g,
     )
+
+
+@pytest.mark.parametrize("S,M,V", [(2, 3, 2), (4, 8, 2), (2, 4, 3),
+                                   (4, 8, 1), (3, 5, 2)])
+def test_interleaved_tables_dataflow_sound(S, M, V) -> None:
+    # Symbolically execute the static tables: every forward must read the
+    # value its upstream virtual stage produced, every backward must read
+    # the right activation and cotangent, and nothing is overwritten
+    # while still live.
+    from torchft_tpu.parallel import interleaved_tables
+
+    tbl = interleaved_tables(S, M, V)
+    T = tbl["ticks"]
+    total_v = V * S
+    fwd_buf = [dict() for _ in range(S)]   # slot -> value id (v, mb)
+    bwd_buf = [dict() for _ in range(S)]
+    act_buf = [dict() for _ in range(S)]
+    h_chan = [None] * S  # value arriving at device s this tick
+    g_chan = [None] * S
+    f_done = set()
+    b_done = set()
+
+    for t in range(T):
+        # stash phase (values sent at t-1)
+        for s in range(S):
+            fs = tbl["f_stash"][t][s]
+            if fs >= 0:
+                assert h_chan[s] is not None, (t, s)
+                fwd_buf[s][fs] = h_chan[s]
+            bs = tbl["b_stash"][t][s]
+            if bs >= 0:
+                assert g_chan[s] is not None, (t, s)
+                bwd_buf[s][bs] = g_chan[s]
+        h_next = [None] * S
+        g_next = [None] * S
+        for s in range(S):
+            f_mb = tbl["f_mb"][t][s]
+            if f_mb >= 0:
+                c = tbl["f_chunk"][t][s]
+                v = c * S + s
+                src = tbl["f_src"][t][s]
+                if v == 0:
+                    assert src == -1
+                else:
+                    # must read EXACTLY the upstream virtual stage's value
+                    assert fwd_buf[s].get(src) == (v - 1, f_mb), (
+                        t, s, v, f_mb, src, fwd_buf[s]
+                    )
+                act_buf[s][tbl["f_act"][t][s]] = (v, f_mb)
+                f_done.add((v, f_mb))
+                if v + 1 < total_v:
+                    h_next[(s + 1) % S] = (v, f_mb)
+            b_mb = tbl["b_mb"][t][s]
+            if b_mb >= 0:
+                c = tbl["b_chunk"][t][s]
+                v = c * S + s
+                assert (v, b_mb) in f_done
+                assert act_buf[s].get(tbl["b_act"][t][s]) == (v, b_mb), (
+                    t, s, v, b_mb
+                )
+                gsrc = tbl["b_gsrc"][t][s]
+                if v == total_v - 1:
+                    assert gsrc == -1
+                else:
+                    assert bwd_buf[s].get(gsrc) == (v + 1, b_mb), (
+                        t, s, v, b_mb, gsrc, bwd_buf[s]
+                    )
+                b_done.add((v, b_mb))
+                if v - 1 >= 0:
+                    g_next[(s - 1) % S] = (v, b_mb)
+        h_chan, g_chan = h_next, g_next
+
+    assert len(f_done) == total_v * M
+    assert len(b_done) == total_v * M
