@@ -264,3 +264,42 @@ def test_hsdp_ft_kill_and_sharded_heal() -> None:
             rtol=1e-5, atol=1e-6,
             err_msg=f"divergence at step {s}",
         )
+
+
+def test_donor_stages_shard_wise() -> None:
+    # The donor must hold SHARD pieces, not assembled arrays (the
+    # multi-host-correct layout): matching-bounds healer requests are
+    # served from a piece directly, and the legacy full fetch still
+    # assembles correctly.
+    from torchft_tpu.checkpointing import _ShardedLeaf, fetch_leaf
+
+    mesh = group_mesh(0)
+    params = shard_group_params(
+        {"layer1": {"w": jnp.arange(
+            D_IN * D_HID, dtype=jnp.float32).reshape(D_IN, D_HID)}},
+        mesh,
+    )
+    donor = CheckpointServer(timeout=5.0)
+    donor.send_checkpoint([1], step=3, state_dict=params, timeout=5.0)
+
+    staged_leaf = donor._staged.leaves[0]
+    assert isinstance(staged_leaf, _ShardedLeaf)
+    assert len(staged_leaf.pieces) == 4  # one piece per fsdp shard
+
+    w = np.arange(D_IN * D_HID, dtype=np.float32).reshape(D_IN, D_HID)
+    # exact shard-bounds request -> served from one piece
+    (bounds, piece), *_ = sorted(staged_leaf.pieces.items())
+    slices = tuple(slice(a, b) for a, b in bounds)
+    got = fetch_leaf(donor.metadata(), 3, 0, slices=slices)
+    np.testing.assert_array_equal(got, w[slices])
+    # a region SPANNING pieces assembles correctly
+    span = fetch_leaf(
+        donor.metadata(), 3, 0, slices=(slice(0, D_IN), slice(2, 10))
+    )
+    np.testing.assert_array_equal(span, w[:, 2:10])
+    # legacy full pickle-stream fetch assembles the whole array
+    full = donor.recv_checkpoint(0, donor.metadata(), 3, 5.0)
+    np.testing.assert_array_equal(
+        np.asarray(full["layer1"]["w"]), w
+    )
+    donor.shutdown()

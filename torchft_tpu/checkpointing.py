@@ -23,13 +23,14 @@ import urllib.request
 from abc import ABC, abstractmethod
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
+from functools import cached_property
 from datetime import timedelta
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Generic, List, Optional, Sequence, TypeVar
 
 import numpy as np
 
-from torchft_tpu.utils.serialization import pytree_from_stream, pytree_to_stream, to_host
+from torchft_tpu.utils.serialization import pytree_from_stream, pytree_to_stream
 
 logger = logging.getLogger(__name__)
 
@@ -45,26 +46,114 @@ __all__ = [
 ]
 
 
+class _ShardedLeaf:
+    """Host copy of one sharded jax.Array, stored SHARD-WISE: per-shard
+    numpy pieces keyed by their global bounds, never assembled unless a
+    request actually spans pieces. This is the multi-host-correct donor
+    structure (each host only ever holds its addressable shards) and
+    skips the full-array assembly device_get would perform."""
+
+    def __init__(self, x) -> None:  # x: jax.Array
+        self.shape = tuple(x.shape)
+        self.dtype = np.dtype(x.dtype)
+        self.nbytes = int(
+            np.prod(self.shape, dtype=np.int64) * self.dtype.itemsize
+        )
+        pieces: dict = {}
+        for shard in x.addressable_shards:
+            bounds = _normalize_index(shard.index, self.shape)
+            if bounds not in pieces:
+                pieces[bounds] = np.asarray(shard.data)
+        self.pieces = pieces
+
+    def read(self, slices: "Optional[tuple]" = None) -> np.ndarray:
+        """Materialize the requested region (default: the full array).
+        Exact shard-bounds requests — the common case when healer and
+        donor share a sharding layout — return the piece directly."""
+        if slices is None:
+            bounds = tuple((0, d) for d in self.shape)
+        else:
+            bounds = _normalize_index(slices, self.shape)
+        hit = self.pieces.get(bounds)
+        if hit is not None:
+            return hit
+        out = np.empty(
+            tuple(b - a for a, b in bounds), dtype=self.dtype
+        )
+        covered = 0
+        for pb, arr in self.pieces.items():
+            # overlap of piece bounds with request bounds, both global
+            inter = [
+                (max(a1, a2), min(b1, b2))
+                for (a1, b1), (a2, b2) in zip(pb, bounds)
+            ]
+            if any(a >= b for a, b in inter):
+                continue
+            src = tuple(
+                slice(a - pa, b - pa)
+                for (a, b), (pa, _) in zip(inter, pb)
+            )
+            dst = tuple(
+                slice(a - ra, b - ra)
+                for (a, b), (ra, _) in zip(inter, bounds)
+            )
+            out[dst] = arr[src]
+            covered += int(
+                np.prod([b - a for a, b in inter], dtype=np.int64)
+            )
+        expect = int(
+            np.prod([b - a for a, b in bounds], dtype=np.int64)
+        )
+        if covered != expect:
+            raise ValueError(
+                f"requested region {bounds} not fully covered by this "
+                "donor's addressable shards (multi-host: fetch the rest "
+                "from the shard-owning host)"
+            )
+        return out
+
+
+def _materialize_leaf(leaf: Any) -> Any:
+    return leaf.read() if isinstance(leaf, _ShardedLeaf) else leaf
+
+
 @dataclass(frozen=True)
 class _Staged:
     """An immutable host copy of one staged checkpoint, pre-flattened so
-    leaf/manifest requests need no per-request tree work."""
+    leaf/manifest requests need no per-request tree work. jax.Array
+    leaves are held shard-wise (_ShardedLeaf)."""
 
     step: int
-    state: Any
     leaves: List[Any]
     manifest_bytes: bytes
     treedef: Any = field(repr=False, default=None)
+
+    @cached_property
+    def state(self) -> Any:
+        """Fully-materialized pytree (legacy full-stream path / tests).
+        Cached: N healing peers on the legacy path share ONE assembly
+        (stage-once-serve-many); cached_property writes the instance
+        __dict__ directly, which frozen dataclasses permit."""
+        import jax
+
+        return jax.tree_util.tree_unflatten(
+            self.treedef, [_materialize_leaf(l) for l in self.leaves]
+        )
 
 
 def _build_staged(step: int, state: Any) -> _Staged:
     import jax
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(state)
-    leaves = [leaf for _, leaf in flat]
+    leaves: List[Any] = []
     entries = []
     for keypath, leaf in flat:
-        if isinstance(leaf, np.ndarray):
+        if isinstance(leaf, jax.Array):
+            leaf = _ShardedLeaf(leaf)  # per-shard D2H, no assembly
+        elif isinstance(leaf, np.ndarray):
+            leaf = np.array(leaf, copy=True)  # detach from live training
+        leaves.append(leaf)
+        if isinstance(leaf, (np.ndarray, _ShardedLeaf)):
             entries.append(
                 {
                     "path": jax.tree_util.keystr(keypath),
@@ -81,7 +170,6 @@ def _build_staged(step: int, state: Any) -> _Staged:
     manifest = {"step": step, "leaves": entries, "treedef": treedef}
     return _Staged(
         step=step,
-        state=state,
         leaves=leaves,
         manifest_bytes=pickle.dumps(manifest, protocol=5),
         treedef=treedef,
@@ -212,6 +300,14 @@ class _Handler(BaseHTTPRequestHandler):
 
         try:
             if len(parts) == 2:  # /checkpoint/{step} — full pickle stream
+                # Materialize BEFORE headers: a multi-host donor whose
+                # shards don't fully cover a leaf raises here, and that
+                # must surface as an error status, not a torn body.
+                try:
+                    full_state = staged.state
+                except ValueError as e:
+                    self.send_error(503, str(e))
+                    return
                 self.send_response(200)
                 self.send_header(
                     "Content-Type", "application/octet-stream"
@@ -219,8 +315,8 @@ class _Handler(BaseHTTPRequestHandler):
                 # Chunked-free streaming: close delimits the body.
                 self.send_header("Connection", "close")
                 self.end_headers()
-                # staged.state is already an all-host copy
-                pytree_to_stream(staged.state, self.wfile, convert=False)
+                # all-host copy (assembled once, cached on the stage)
+                pytree_to_stream(full_state, self.wfile, convert=False)
                 self.close_connection = True
                 return
 
@@ -244,7 +340,10 @@ class _Handler(BaseHTTPRequestHandler):
                 if not (0 <= lo <= hi <= len(staged.leaves)):
                     self.send_error(404, f"bad leaf range {lo}-{hi}")
                     return
-                body = pickle.dumps(staged.leaves[lo:hi], protocol=5)
+                body = pickle.dumps(
+                    [_materialize_leaf(l) for l in staged.leaves[lo:hi]],
+                    protocol=5,
+                )
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -261,7 +360,7 @@ class _Handler(BaseHTTPRequestHandler):
                     self.send_error(404, f"no leaf {idx}")
                     return
                 leaf = staged.leaves[idx]
-                if not isinstance(leaf, np.ndarray):
+                if not isinstance(leaf, (np.ndarray, _ShardedLeaf)):
                     body = pickle.dumps(leaf, protocol=5)
                     self.send_response(200)
                     self.send_header("X-Kind", "object")
@@ -270,9 +369,17 @@ class _Handler(BaseHTTPRequestHandler):
                     self.wfile.write(body)
                     return
                 spec = parse_qs(url.query).get("slice", [None])[0]
-                if spec is not None:
-                    # Server-side shard slicing: only the healer's shard
-                    # bytes cross the wire (SURVEY.md §7 hard part 3).
+                # Server-side shard slicing: only the healer's shard
+                # bytes cross the wire (SURVEY.md §7 hard part 3). For a
+                # shard-wise staged leaf, a matching-bounds request is
+                # served from the piece directly, no copies.
+                if isinstance(leaf, _ShardedLeaf):
+                    slices = (
+                        _parse_slice_spec(spec, leaf.shape)
+                        if spec is not None else None
+                    )
+                    leaf = leaf.read(slices)
+                elif spec is not None:
                     leaf = leaf[_parse_slice_spec(spec, leaf.shape)]
                 body_arr = np.ascontiguousarray(leaf)
                 # tobytes, not memoryview: ml_dtypes arrays (bfloat16,
@@ -352,10 +459,12 @@ class CheckpointServer(CheckpointTransport[T]):
         self, dst_ranks: List[int], step: int, state_dict: T,
         timeout: "float | timedelta",
     ) -> None:
-        # Stage a host copy NOW (device_get) so later training-step mutations
-        # of device state can't tear the served bytes, then open the gate.
+        # Stage a host copy NOW so later training-step mutations of
+        # device state can't tear the served bytes, then open the gate.
+        # jax.Array leaves are copied SHARD-wise (one D2H per addressable
+        # shard, never assembled) — the multi-host-correct donor layout.
         del dst_ranks  # HTTP transport serves whoever fetches
-        staged = _build_staged(step, to_host(state_dict))
+        staged = _build_staged(step, state_dict)
         with self._cond:
             self._staged = staged
             self._disallowed = False
