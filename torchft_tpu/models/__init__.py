@@ -4,6 +4,13 @@ from torchft_tpu.models.mlp import (  # noqa: F401
     linear_forward,
     mlp_forward,
 )
+from torchft_tpu.models.llama import (  # noqa: F401
+    LLAMA_CONFIGS,
+    LlamaConfig,
+    llama_forward,
+    llama_init_params,
+    llama_loss_fn,
+)
 from torchft_tpu.models.transformer import (  # noqa: F401
     CONFIGS,
     TransformerConfig,
