@@ -106,3 +106,33 @@ def test_lighthouse_cli_starts_and_serves() -> None:
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_train_hsdp_example_runs() -> None:
+    # The HSDP example (fsdp/tp-sharded group + sharded-heal transport)
+    # must train end-to-end as a real subprocess against a real
+    # lighthouse — the apps-level seal on the sharded composition.
+    import os
+
+    from torchft_tpu.control import Lighthouse
+
+    lh = Lighthouse(min_replicas=1, join_timeout_ms=200)
+    env = dict(os.environ)
+    env.update(
+        TORCHFT_TPU_LIGHTHOUSE=lh.address(),
+        TOTAL_STEPS="3",
+        REPLICA_GROUP_ID="0",
+        LOGLEVEL="ERROR",
+        JAX_PLATFORMS="cpu",
+    )
+    env.pop("PYTHONPATH", None)  # drop the axon sitecustomize
+    try:
+        proc = subprocess.run(
+            [sys.executable, "examples/train_hsdp.py"],
+            env=env, capture_output=True, text=True, timeout=120,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "step 3" in proc.stdout, proc.stdout
+    finally:
+        lh.shutdown()

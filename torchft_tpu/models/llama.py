@@ -54,6 +54,10 @@ class LlamaConfig:
             f"n_heads {self.n_heads} not divisible by n_kv_heads "
             f"{self.n_kv_heads} (GQA repeat factor must be integral)"
         )
+        assert (self.d_model // self.n_heads) % 2 == 0, (
+            f"head_dim {self.d_model // self.n_heads} must be even "
+            "(RoPE rotates dimension pairs)"
+        )
 
     @property
     def head_dim(self) -> int:
