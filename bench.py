@@ -48,6 +48,7 @@ _PEAK_FLOPS_BY_KIND = [
     ("v5p", 459e12),
     ("v6e", 918e12),
     ("v6 lite", 918e12),
+    ("v4i", 138e12),      # must precede "v4": substring match
     ("v4", 275e12),
     ("v3", 123e12),
 ]
@@ -68,13 +69,15 @@ def _peak_flops(device) -> "float | None":
 _CLEANUPS: "list" = []
 
 
-def _emit(payload: dict) -> None:
+def _emit(payload: dict, code: int = 0) -> None:
     """Print the bench JSON as the process's final act and exit.
 
     Two consecutive rounds lost their graded perf number to post-JSON
     teardown noise (VERDICT r02: a manager traceback after the print made
     the driver's tail unparseable). Nothing — logging, daemon threads,
-    atexit hooks, interpreter teardown — may run after this.
+    atexit hooks, interpreter teardown — may run after this. The native
+    control-plane threads write to C-level fd 2, which rebinding
+    sys.stderr cannot intercept — dup2 the fd itself to /dev/null.
     """
     try:
         sys.stderr.flush()
@@ -82,11 +85,12 @@ def _emit(payload: dict) -> None:
         pass
     try:
         sys.stderr = open(os.devnull, "w")
+        os.dup2(sys.stderr.fileno(), 2)
     except Exception:
         pass
     sys.stdout.write(json.dumps(payload) + "\n")
     sys.stdout.flush()
-    os._exit(0)
+    os._exit(code)
 
 
 def _forward_child_output(out: "subprocess.CompletedProcess") -> None:
@@ -251,8 +255,19 @@ def _child_main() -> None:
     tx = optax.adamw(3e-4, weight_decay=0.01)
     holder = {"params": params, "opt": tx.init(params)}
 
-    batch = int(os.environ.get("BENCH_CHILD_BATCH", "1"))
-    seq = min(cfg.max_seq_len, 256)
+    if sync_grads:
+        # Lockstep participant (CPU parent): train the SAME shape as the
+        # parent so the measured 2-participant averaging is symmetric.
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        seq = min(
+            int(os.environ.get("BENCH_SEQ", cfg.max_seq_len)),
+            cfg.max_seq_len,
+        )
+    else:
+        # Background grads on a TPU parent's host: the payload is zeroed
+        # by the manager anyway (behind-cohort), keep the CPU cost small.
+        batch = int(os.environ.get("BENCH_CHILD_BATCH", "1"))
+        seq = min(cfg.max_seq_len, 256)
     rng = np.random.default_rng(1000 + idx)
     tokens = jax.numpy.asarray(
         rng.integers(0, cfg.vocab_size, (batch, seq)), dtype=jax.numpy.int32
@@ -329,6 +344,14 @@ def _child_main() -> None:
                 grads = grad_box["grads"]
                 if grads is None:
                     grads = zero_grads
+            manager.wait_quorum()
+            if manager.replica_world_size() <= 1:
+                # Alone in the quorum (the parent paused or is tearing
+                # down): do NOT commit — a child advancing the global max
+                # step solo would force the parent to heal from the
+                # child's state when it resumes.
+                time.sleep(0.05)
+                continue
             avg = ddp.average_gradients(grads)
             p, s, ok = opt.step(holder["params"], holder["opt"], avg)
             if ok:
@@ -660,10 +683,23 @@ def _run() -> None:
             standby_proc = spawn(1, standby=True)
             extra_procs.append(standby_proc)
             chaos_respawn = "warm_standby"
-            rlist, _, _ = select.select(
-                [standby_proc.stdout], [], [], 120.0
-            )
-            if not rlist or b"ready" not in standby_proc.stdout.readline():
+            # Keep stepping while the standby warms up: a parent that
+            # pauses lets the live child's quorum requests hit the join
+            # timeout every round, and a paused parent falling behind
+            # max_step would heal FROM the child when it resumes.
+            standby_ready = False
+            ready_deadline = time.perf_counter() + 120.0
+            while time.perf_counter() < ready_deadline:
+                rlist, _, _ = select.select(
+                    [standby_proc.stdout], [], [], 0
+                )
+                if rlist:
+                    standby_ready = (
+                        b"ready" in standby_proc.stdout.readline()
+                    )
+                    break
+                loss = ft_step()
+            if not standby_ready:
                 sys.stderr.write(
                     "bench: warm standby never became ready; "
                     "falling back to cold respawn\n"
@@ -824,7 +860,8 @@ def main() -> None:
                 "unit": "error",
                 "vs_baseline": 0.0,
                 "error": repr(e),
-            }
+            },
+            code=1,
         )
 
 
