@@ -92,6 +92,156 @@ def test_sharded_recv_rejects_structure_mismatch() -> None:
     donor.shutdown()
 
 
+def test_multihost_donor_fanout() -> None:
+    """VERDICT r02 item 6: a healer whose shard layout spans donor HOSTS
+    fetches each region from the host that owns it. Two checkpoint servers
+    simulate the two hosts of one donor group (the shard_filter staging
+    seam models real multi-host, where addressable_shards yields only the
+    local pieces); each stages half the fsdp shards and advertises the
+    other as a peer. A healer resharded COLUMN-wise needs rows from both
+    hosts for every region — closing checkpointing.py's former 503 path."""
+    mesh = group_mesh(0)
+    w = jnp.arange(D_IN * D_HID, dtype=jnp.float32).reshape(D_IN, D_HID)
+    state = {
+        "user": shard_group_params({"layer1": {"w": w}}, mesh),
+        "torchft": {"step": 7, "batches_committed": 14},
+    }
+    host_a = CheckpointServer(timeout=5.0)
+    host_b = CheckpointServer(timeout=5.0)
+    try:
+        # the fsdp helper shards the largest divisible dim — columns
+        # here; host A holds the left-half column shards, B the right
+        host_a._shard_filter = lambda path, b: b[1][0] < D_HID // 2
+        host_b._shard_filter = lambda path, b: b[1][0] >= D_HID // 2
+        host_a.set_peers([host_b.metadata()])
+        host_b.set_peers([host_a.metadata()])
+        for h in (host_a, host_b):
+            h.send_checkpoint([1], step=7, state_dict=state, timeout=5.0)
+
+        from torchft_tpu.checkpointing import fetch_manifest
+
+        man = fetch_manifest(host_a.metadata(), 7)
+        w_entry = next(
+            e for e in man["leaves"] if "layer1" in e["path"]
+        )
+        assert len(w_entry["pieces"]) == 2  # A holds 2 of the 4 shards
+        assert man["peers"] == [host_b.metadata()]
+
+        # healer resharded ROW-wise: every row shard spans both hosts'
+        # column pieces -> pure fan-out assembly
+        mesh2 = group_mesh(1)
+        tmpl_w = jax.device_put(
+            jnp.zeros((D_IN, D_HID), jnp.float32),
+            NamedSharding(mesh2, P("fsdp", None)),
+        )
+        template = {
+            "user": {"layer1": {"w": tmpl_w}},
+            "torchft": {"step": 0, "batches_committed": 0},
+        }
+        got = recv_checkpoint_sharded(
+            host_a.metadata(), 7, template, timeout=5.0
+        )
+        healed = got["user"]["layer1"]["w"]
+        assert healed.sharding == tmpl_w.sharding
+        np.testing.assert_array_equal(np.asarray(healed), np.asarray(w))
+        assert got["torchft"]["step"] == 7
+
+        # matching column layout: regions held by B are routed to B whole
+        tmpl_row = shard_group_params(
+            {"layer1": {"w": jnp.zeros((D_IN, D_HID), jnp.float32)}},
+            mesh2,
+        )
+        got2 = recv_checkpoint_sharded(
+            host_a.metadata(), 7,
+            {"user": tmpl_row,
+             "torchft": {"step": 0, "batches_committed": 0}},
+            timeout=5.0,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got2["user"]["layer1"]["w"]), np.asarray(w)
+        )
+    finally:
+        host_a.shutdown()
+        host_b.shutdown()
+
+
+def test_multihost_donor_gap_is_loud() -> None:
+    """A region NO donor host holds must fail with the prescriptive
+    resharding error, never a torn heal."""
+    mesh = group_mesh(0)
+    state = {
+        "w": shard_group_params(
+            {"w": jnp.zeros((D_IN, D_HID), jnp.float32)}, mesh
+        )["w"],
+    }
+    host_a = CheckpointServer(timeout=5.0)
+    host_b = CheckpointServer(timeout=5.0)
+    try:
+        # columns [4,6) are held by NOBODY
+        host_a._shard_filter = lambda path, b: b[1][0] < 4
+        host_b._shard_filter = lambda path, b: b[1][0] >= 6
+        host_a.set_peers([host_b.metadata()])
+        for h in (host_a, host_b):
+            h.send_checkpoint([1], step=1, state_dict=state, timeout=5.0)
+        mesh2 = group_mesh(1)
+        template = {
+            "w": jax.device_put(
+                jnp.zeros((D_IN, D_HID), jnp.float32),
+                NamedSharding(mesh2, P("fsdp", None)),
+            ),
+        }
+        with pytest.raises(ValueError, match="not covered"):
+            recv_checkpoint_sharded(
+                host_a.metadata(), 1, template, timeout=5.0
+            )
+    finally:
+        host_a.shutdown()
+        host_b.shutdown()
+
+
+def test_route_region_overlap_cannot_mask_gap() -> None:
+    """Overlapping cross-host pieces whose total VOLUME matches the
+    request must still fail when part of the region is uncovered — a
+    volume-counting check would heal uninitialized memory here."""
+    from torchft_tpu.checkpointing import _route_region
+
+    bounds = ((0, 8),)
+    piece_maps = {
+        "http://a": [((0, 4),)],
+        "http://b": [((2, 6),)],  # overlaps A; [6,8) held by nobody
+    }
+    with pytest.raises(ValueError, match="not covered"):
+        _route_region(bounds, piece_maps)
+    # and with the gap closed, the same overlap routes fine
+    piece_maps["http://b"].append(((6, 8),))
+    plan = _route_region(bounds, piece_maps)
+    assert set(b for _, b in plan) == {((0, 4),), ((2, 6),), ((6, 8),)}
+
+
+def test_sharded_recv_rejects_dtype_mismatch() -> None:
+    """ADVICE r02: a donor/healer dtype skew must error, not heal with a
+    silent astype precision change."""
+    mesh = group_mesh(0)
+    donor = CheckpointServer(timeout=5.0)
+    try:
+        donor.send_checkpoint(
+            [1], step=1,
+            state_dict=shard_group_params(
+                {"w": jnp.zeros((D_IN, D_HID), jnp.float32)}, mesh
+            ),
+            timeout=5.0,
+        )
+        template = shard_group_params(
+            {"w": jnp.zeros((D_IN, D_HID), jnp.bfloat16)}, group_mesh(1)
+        )
+        with pytest.raises(ValueError, match="dtype mismatch"):
+            recv_checkpoint_sharded(
+                donor.metadata(), 1, template, timeout=5.0
+            )
+    finally:
+        donor.shutdown()
+
+
 class _HsdpReplica:
     """One replica group: fsdp-sharded params + FT manager loop."""
 
@@ -264,6 +414,210 @@ def test_hsdp_ft_kill_and_sharded_heal() -> None:
             rtol=1e-5, atol=1e-6,
             err_msg=f"divergence at step {s}",
         )
+
+
+def test_hsdp_multirank_kill_and_per_rank_sharded_heal() -> None:
+    """VERDICT r02 item 5: world_size=2 ranks per replica group, each rank
+    owning its own fsdp sub-mesh and its OWN shard of the training state;
+    the whole 2-rank group is killed and each relaunched rank heals
+    rank-to-rank — rank r fetches the donor group's rank-r metadata via
+    the manager's per-rank CheckpointMetadata (ref manager.rs:276-293
+    semantics, native/manager.cc:187-202) and lands the leaves on its own
+    NamedShardings via the sharded checkpoint path."""
+    lighthouse = Lighthouse(
+        min_replicas=1, join_timeout_ms=300, heartbeat_timeout_ms=1000
+    )
+    num_groups, ranks_per_group, target_commits = 2, 2, 6
+    stop = threading.Event()
+    lock = threading.Lock()
+    commits: Dict[tuple, int] = {}
+    history: Dict[tuple, Dict[int, np.ndarray]] = {
+        (g, r): {} for g in range(num_groups) for r in range(ranks_per_group)
+    }
+    sharding_ok: Dict[tuple, bool] = {}
+    kill_group, kill_at_step = 1, 3
+    kill_count = [0]
+
+    def rank_mesh(group: int, rank: int):
+        # each rank owns a DISJOINT 2-device fsdp mesh: 2 groups x 2 ranks
+        # x 2 devices = the full virtual-8 platform
+        devs = jax.devices()[group * 4 + rank * 2: group * 4 + rank * 2 + 2]
+        return ft_mesh({"fsdp": 2}, devices=devs)
+
+    def rank_params(rank: int, seed: float, mesh):
+        # rank-DISTINCT state (rank r holds its own shard of the logical
+        # model): a cross-rank heal mixup would poison the trajectory
+        return shard_pytree(
+            {"w": jnp.full((D_IN, D_HID), seed + 100.0 * rank, jnp.float32)},
+            mesh, tp_rules=None, fsdp_axis="fsdp",
+        )
+
+    def rank_main(group, rank, store_addr, restarted, killed, errors):
+        mesh = rank_mesh(group, rank)
+        target = jnp.full((D_IN, D_HID), 10.0 * (rank + 1), jnp.float32)
+        holder = {
+            "params": rank_params(rank, 99.0 if restarted else 1.0, mesh)
+        }
+
+        def state_dict():
+            return {"params": holder["params"]}
+
+        def load_state_dict(sd):
+            leaf = sd["params"]["w"]
+            ok = isinstance(leaf, jax.Array) and leaf.sharding.spec in (
+                P("fsdp", None), P(None, "fsdp")
+            )
+            with lock:
+                sharding_ok[(group, rank)] = (
+                    sharding_ok.get((group, rank), True) and ok
+                )
+            holder["params"] = sd["params"]
+
+        transport = CheckpointServer(
+            timeout=5.0,
+            template_fn=lambda: {
+                "user": state_dict(),
+                "torchft": {"step": 0, "batches_committed": 0},
+            },
+        )
+
+        @jax.jit
+        def grad_step(params):
+            def loss_fn(p):
+                return jnp.mean((p["w"] - target) ** 2)
+
+            return jax.value_and_grad(loss_fn)(params)
+
+        manager = Manager(
+            comm=TcpCommContext(timeout=5.0),
+            load_state_dict=load_state_dict,
+            state_dict=state_dict,
+            checkpoint_transport=transport,
+            min_replica_size=1,
+            use_async_quorum=True,
+            timeout=10.0, quorum_timeout=10.0, connect_timeout=10.0,
+            rank=rank,
+            world_size=ranks_per_group,
+            store_addr=store_addr,
+            lighthouse_addr=lighthouse.address(),
+            replica_id=f"hsdp_mr_{group}_",
+            heartbeat_interval=0.05,
+        )
+        try:
+            while not stop.is_set() and not killed.is_set():
+                if (
+                    group == kill_group
+                    and not restarted
+                    and manager.current_step() >= kill_at_step
+                ):
+                    killed.set()
+                    kill_count[0] += 1
+                    return
+                try:
+                    manager.start_quorum()
+                    with mesh:
+                        loss, grads = grad_step(holder["params"])
+                    avg = manager.allreduce_pytree(grads).result(timeout=20)
+                    committed = manager.should_commit()
+                except (TimeoutError, RuntimeError) as e:
+                    logger.info("step retry g%d r%d: %s", group, rank, e)
+                    continue
+                if committed:
+                    new_params = jax.tree_util.tree_map(
+                        lambda p, g: jax.device_put(
+                            p - 0.2 * jnp.asarray(np.asarray(g), p.dtype),
+                            p.sharding,
+                        ),
+                        holder["params"], avg,
+                    )
+                    holder["params"] = new_params
+                    step = manager.current_step()
+                    history[(group, rank)][step] = np.asarray(
+                        holder["params"]["w"]
+                    )
+                    with lock:
+                        commits[(group, rank)] = (
+                            commits.get((group, rank), 0) + 1
+                        )
+                        if all(
+                            commits.get((g, r), 0) >= target_commits
+                            for g in range(num_groups)
+                            for r in range(ranks_per_group)
+                        ):
+                            stop.set()
+                else:
+                    time.sleep(0.01)
+        except Exception as e:  # noqa: BLE001
+            errors.append((group, rank, e))
+        finally:
+            manager.shutdown(wait=False)
+
+    def group_main(group, errors):
+        restarted = False
+        while not stop.is_set():
+            store = StoreServer()
+            killed = threading.Event()
+            rank_threads = [
+                threading.Thread(
+                    target=rank_main,
+                    args=(group, r, store.addr, restarted, killed, errors),
+                    daemon=True,
+                )
+                for r in range(ranks_per_group)
+            ]
+            for t in rank_threads:
+                t.start()
+            for t in rank_threads:
+                t.join(timeout=150)
+            store.shutdown()
+            if killed.is_set() and not stop.is_set():
+                logger.warning("group %d killed; restarting both ranks",
+                               group)
+                restarted = True
+                continue
+            return
+
+    errors: list = []
+    group_threads = [
+        threading.Thread(target=group_main, args=(g, errors), daemon=True)
+        for g in range(num_groups)
+    ]
+    try:
+        for t in group_threads:
+            t.start()
+        deadline = time.monotonic() + 150
+        for t in group_threads:
+            t.join(timeout=max(1.0, deadline - time.monotonic()))
+    finally:
+        stop.set()
+        lighthouse.shutdown()
+
+    assert not errors, errors
+    assert kill_count[0] >= 1, "kill never fired"
+    for g in range(num_groups):
+        for r in range(ranks_per_group):
+            assert commits.get((g, r), 0) >= target_commits, (g, r, commits)
+    # every heal landed leaves with the healer rank's own fsdp sharding
+    assert sharding_ok.get((kill_group, 0), True) and sharding_ok.get(
+        (kill_group, 1), True
+    ), sharding_ok
+    # the restarted group actually healed (its load_state_dict ran)
+    assert (kill_group, 0) in sharding_ok and (kill_group, 1) in sharding_ok
+
+    # Per-rank trajectory oracle: counterpart ranks across groups must
+    # match step-for-step, INCLUDING post-heal — with rank-distinct
+    # targets and values, a rank-mixed heal (rank 0 fetching rank 1's
+    # shard) would diverge immediately.
+    for r in range(ranks_per_group):
+        h0, h1 = history[(0, r)], history[(1, r)]
+        common = sorted(set(h0) & set(h1))
+        post_heal = [s for s in common if s > kill_at_step + 1]
+        assert post_heal, f"rank {r}: no common steps after heal: {common}"
+        for s in common:
+            np.testing.assert_allclose(
+                h0[s], h1[s], rtol=1e-5, atol=1e-6,
+                err_msg=f"rank {r} divergence at step {s}",
+            )
 
 
 def test_donor_stages_shard_wise() -> None:

@@ -1,0 +1,261 @@
+"""TP x FT end-to-end: Megatron-style tensor-parallel in-group state
+composed with the Manager fault-tolerance loop, including kill + sharded
+heal.
+
+VERDICT r02 item 7: HSDP x FT proved the replica-group abstraction stays
+orthogonal to the in-group mesh; this is the same composition with an
+in-group ``{"tensor": 4}`` mesh and ``tp_rules_gpt()`` shardings (column-
+parallel q/up, row-parallel o/down — parallel/sharding.py:85). Two replica
+groups each own a disjoint 4-device tensor mesh carved from the 8-device
+virtual CPU platform; cross-group gradient averaging runs through the
+Manager/DCN transport; one group is killed mid-run and heals through the
+sharding-aware checkpoint path onto its own tensor-sharded layout.
+"""
+
+import logging
+import threading
+import time
+from typing import Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from torchft_tpu.checkpointing import CheckpointServer
+from torchft_tpu.comm.store import StoreServer
+from torchft_tpu.comm.transport import TcpCommContext
+from torchft_tpu.control import Lighthouse
+from torchft_tpu.manager import Manager
+from torchft_tpu.parallel import ft_mesh, shard_pytree
+from torchft_tpu.parallel.sharding import tp_rules_gpt
+
+logger = logging.getLogger(__name__)
+
+D = 8          # model dim, divisible by tensor=4
+D_FF = 16
+
+
+def make_params(seed: float):
+    """Mini transformer block whose path names hit the tp_rules_gpt
+    patterns (attn q/o column/row, mlp up/down column/row)."""
+
+    def full(shape, v):
+        return jnp.full(shape, v, jnp.float32)
+
+    return {
+        "layers_0": {
+            "attn": {
+                "q_proj": {"kernel": full((D, D), seed)},
+                "o_proj": {"kernel": full((D, D), seed / 2)},
+            },
+            "mlp": {
+                "up_proj": {"kernel": full((D, D_FF), seed / 3)},
+                "down_proj": {"kernel": full((D_FF, D), seed / 4)},
+            },
+        },
+    }
+
+
+_EXPECTED_SPECS = {
+    "q_proj": P(None, "tensor"),   # column-parallel
+    "o_proj": P("tensor", None),   # row-parallel
+    "up_proj": P(None, "tensor"),
+    "down_proj": P("tensor", None),
+}
+
+
+def group_mesh(group: int):
+    devs = jax.devices()[group * 4: group * 4 + 4]
+    return ft_mesh({"tensor": 4}, devices=devs)
+
+
+def shard_group_params(params, mesh):
+    return shard_pytree(
+        params, mesh, tp_rules=tp_rules_gpt(), fsdp_axis=None
+    )
+
+
+def test_tp_sharding_rules_applied() -> None:
+    mesh = group_mesh(0)
+    params = shard_group_params(make_params(1.0), mesh)
+    block = params["layers_0"]
+    for mod, sub in (("attn", "q_proj"), ("attn", "o_proj"),
+                     ("mlp", "up_proj"), ("mlp", "down_proj")):
+        leaf = block[mod][sub]["kernel"]
+        assert leaf.sharding.spec == _EXPECTED_SPECS[sub], (
+            sub, leaf.sharding.spec
+        )
+
+
+class _Killed(Exception):
+    pass
+
+
+class _TpReplica:
+    """One replica group: tensor-parallel params + FT manager loop."""
+
+    def __init__(self, harness, group: int, lighthouse_addr: str,
+                 fail_at_step: int = -1):
+        self.harness = harness
+        self.group = group
+        self.lighthouse_addr = lighthouse_addr
+        self.fail_at_step = fail_at_step
+        self.history: Dict[int, np.ndarray] = {}
+        self.healed_shardings_ok = True
+        self.healed = False
+
+    def run(self) -> None:
+        restarted = False
+        while not self.harness["stop"].is_set():
+            try:
+                self._main(restarted)
+                return
+            except _Killed:
+                logger.warning("tp group %d restarting after kill",
+                               self.group)
+                restarted = True
+                continue
+
+    def _main(self, restarted: bool) -> None:
+        mesh = group_mesh(self.group)
+        store = StoreServer()
+        seed = 99.0 if restarted else 1.0
+        holder = {"params": shard_group_params(make_params(seed), mesh)}
+
+        def state_dict():
+            return {"params": holder["params"]}
+
+        def load_state_dict(sd):
+            block = sd["params"]["layers_0"]
+            for mod, sub in (("attn", "q_proj"), ("attn", "o_proj"),
+                             ("mlp", "up_proj"), ("mlp", "down_proj")):
+                leaf = block[mod][sub]["kernel"]
+                if not isinstance(leaf, jax.Array) or (
+                    leaf.sharding.spec != _EXPECTED_SPECS[sub]
+                ):
+                    self.healed_shardings_ok = False
+            holder["params"] = sd["params"]
+            self.healed = True
+
+        transport = CheckpointServer(
+            timeout=5.0, template_fn=lambda: {
+                "user": state_dict(),
+                "torchft": {"step": 0, "batches_committed": 0},
+            },
+        )
+        x = jnp.ones((4, D), jnp.float32)
+
+        @jax.jit
+        def grad_step(params):
+            def loss_fn(p):
+                blk = p["layers_0"]
+                h = jnp.tanh(x @ blk["attn"]["q_proj"]["kernel"])
+                h = h @ blk["attn"]["o_proj"]["kernel"]
+                h = jnp.tanh(h @ blk["mlp"]["up_proj"]["kernel"])
+                out = h @ blk["mlp"]["down_proj"]["kernel"]
+                return jnp.mean((out - 1.0) ** 2)
+
+            return jax.value_and_grad(loss_fn)(params)
+
+        manager = Manager(
+            comm=TcpCommContext(timeout=5.0),
+            load_state_dict=load_state_dict,
+            state_dict=state_dict,
+            checkpoint_transport=transport,
+            min_replica_size=1,
+            use_async_quorum=True,
+            timeout=10.0, quorum_timeout=10.0, connect_timeout=10.0,
+            rank=0, world_size=1,
+            store_addr=store.addr,
+            lighthouse_addr=self.lighthouse_addr,
+            replica_id=f"tp_{self.group}_",
+            heartbeat_interval=0.05,
+        )
+        try:
+            while not self.harness["stop"].is_set():
+                if (not restarted
+                        and manager.current_step() == self.fail_at_step):
+                    raise _Killed()
+                try:
+                    manager.start_quorum()
+                except (TimeoutError, RuntimeError) as e:
+                    logger.info("quorum retry: %s", e)
+                    continue
+                with mesh:
+                    loss, grads = grad_step(holder["params"])
+                avg = manager.allreduce_pytree(grads).result(timeout=20)
+                if manager.should_commit():
+                    new_params = jax.tree_util.tree_map(
+                        lambda p, g: jax.device_put(
+                            p - 0.05 * jnp.asarray(np.asarray(g), p.dtype),
+                            p.sharding,
+                        ),
+                        holder["params"], avg,
+                    )
+                    holder["params"] = new_params
+                    committed = manager.current_step()
+                    self.history[committed] = np.asarray(
+                        holder["params"]["layers_0"]["attn"]["q_proj"][
+                            "kernel"
+                        ]
+                    )
+                    with self.harness["lock"]:
+                        counts = self.harness["commits"]
+                        counts[self.group] = counts.get(self.group, 0) + 1
+                        if all(
+                            counts.get(g, 0) >= self.harness["target"]
+                            for g in range(2)
+                        ):
+                            self.harness["stop"].set()
+                else:
+                    time.sleep(0.01)
+        finally:
+            manager.shutdown(wait=False)
+            store.shutdown()
+
+
+def test_tp_ft_kill_and_sharded_heal() -> None:
+    lighthouse = Lighthouse(
+        min_replicas=1, join_timeout_ms=300, heartbeat_timeout_ms=1000
+    )
+    harness = {
+        "stop": threading.Event(),
+        "lock": threading.Lock(),
+        "commits": {},
+        "target": 6,
+    }
+    replicas = [
+        _TpReplica(harness, 0, lighthouse.address()),
+        _TpReplica(harness, 1, lighthouse.address(), fail_at_step=3),
+    ]
+    threads = [
+        threading.Thread(target=r.run, name=f"tp{r.group}", daemon=True)
+        for r in replicas
+    ]
+    deadline = time.time() + 120
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(max(1.0, deadline - time.time()))
+    harness["stop"].set()
+    lighthouse.shutdown()
+
+    assert harness["commits"].get(0, 0) >= harness["target"]
+    assert harness["commits"].get(1, 0) >= harness["target"]
+    # the killed group healed, and every healed leaf carried the exact
+    # Megatron spec (column/row) on the healer's own tensor mesh
+    assert replicas[1].healed, "killed group never healed"
+    assert all(r.healed_shardings_ok for r in replicas)
+
+    common = sorted(set(replicas[0].history) & set(replicas[1].history))
+    assert len(common) >= 3, f"too few common steps: {common}"
+    post_heal = [s for s in common if s > 4]
+    assert post_heal, "no common steps after the kill/heal"
+    for s in common:
+        np.testing.assert_allclose(
+            replicas[0].history[s], replicas[1].history[s],
+            rtol=1e-5, atol=1e-6,
+            err_msg=f"divergence at step {s}",
+        )

@@ -11,6 +11,14 @@ gate for a specific step; `should_commit` closes it again
 The payload is a streamed pytree pickle (device→host via
 utils/serialization); on TPU the device_get happens once at staging time,
 and a donor can serve many healing peers from the same staged host copy.
+
+Trust model: like the reference's torch.load-based transport
+(/root/reference/torchft/checkpointing.py), the full-stream, manifest, and
+leaves endpoints deserialize PICKLE from whatever address quorum metadata
+names — run it on a trusted cluster network only. The per-leaf shard
+endpoint (`/checkpoint/{step}/leaf/{i}`) is raw bytes + dtype/shape
+headers, with no code-execution surface; the sharded heal path
+(`recv_checkpoint_sharded`) uses pickle only for the manifest.
 """
 
 from __future__ import annotations
@@ -141,33 +149,57 @@ class _Staged:
         )
 
 
-def _build_staged(step: int, state: Any) -> _Staged:
+def _build_staged(step: int, state: Any,
+                  peers: "Optional[List[str]]" = None,
+                  shard_filter: "Optional[Any]" = None) -> _Staged:
+    """``peers``: other hosts' checkpoint server addresses for this replica
+    group, advertised in the manifest so a healer whose shards span donor
+    hosts can fan out. ``shard_filter(path, bounds) -> bool`` drops pieces
+    at staging time — the single-process simulation of a real multi-host
+    donor, where ``addressable_shards`` only ever yields the local ones."""
     import jax
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(state)
     leaves: List[Any] = []
     entries = []
     for keypath, leaf in flat:
+        path = jax.tree_util.keystr(keypath)
         if isinstance(leaf, jax.Array):
             leaf = _ShardedLeaf(leaf)  # per-shard D2H, no assembly
+            if shard_filter is not None:
+                leaf.pieces = {
+                    b: arr for b, arr in leaf.pieces.items()
+                    if shard_filter(path, b)
+                }
         elif isinstance(leaf, np.ndarray):
             leaf = np.array(leaf, copy=True)  # detach from live training
         leaves.append(leaf)
         if isinstance(leaf, (np.ndarray, _ShardedLeaf)):
+            pieces = (
+                sorted(leaf.pieces)
+                if isinstance(leaf, _ShardedLeaf)
+                else [tuple((0, d) for d in leaf.shape)]
+            )
             entries.append(
                 {
-                    "path": jax.tree_util.keystr(keypath),
+                    "path": path,
                     "kind": "ndarray",
                     "dtype": str(leaf.dtype),
                     "shape": tuple(leaf.shape),
                     "nbytes": int(leaf.nbytes),
+                    # global bounds of the pieces THIS host holds: the
+                    # healer routes region fetches with these
+                    "pieces": pieces,
                 }
             )
         else:
-            entries.append(
-                {"path": jax.tree_util.keystr(keypath), "kind": "object"}
-            )
-    manifest = {"step": step, "leaves": entries, "treedef": treedef}
+            entries.append({"path": path, "kind": "object"})
+    manifest = {
+        "step": step,
+        "leaves": entries,
+        "treedef": treedef,
+        "peers": list(peers or []),
+    }
     return _Staged(
         step=step,
         leaves=leaves,
@@ -432,6 +464,8 @@ class CheckpointServer(CheckpointTransport[T]):
         self._cond = threading.Condition()
         self._disallowed = True
         self._staged: Optional[_Staged] = None
+        self._peers: List[str] = []
+        self._shard_filter = None  # test seam: simulate multi-host staging
 
         self._server = ThreadingHTTPServer(("0.0.0.0", 0), _Handler)
         self._server.daemon_threads = True
@@ -464,11 +498,21 @@ class CheckpointServer(CheckpointTransport[T]):
         # jax.Array leaves are copied SHARD-wise (one D2H per addressable
         # shard, never assembled) — the multi-host-correct donor layout.
         del dst_ranks  # HTTP transport serves whoever fetches
-        staged = _build_staged(step, state_dict)
+        staged = _build_staged(
+            step, state_dict, peers=self._peers,
+            shard_filter=self._shard_filter,
+        )
         with self._cond:
             self._staged = staged
             self._disallowed = False
             self._cond.notify_all()
+
+    def set_peers(self, peers: List[str]) -> None:
+        """Register the other hosts' checkpoint server addresses for this
+        replica group. Advertised in every staged manifest so a healer
+        whose shard layout spans donor hosts can fetch each region from
+        the host that owns it (the multi-host fan-out path)."""
+        self._peers = [p for p in peers if p != self._addr]
 
     def disallow_checkpoint(self) -> None:
         with self._cond:
@@ -589,6 +633,74 @@ def _bounds_to_slices(bounds) -> "tuple[slice, ...]":
     return tuple(slice(a, b) for a, b in bounds)
 
 
+def _intersect(a, b):
+    """Intersection of two bounds tuples, or None if empty."""
+    out = tuple(
+        (max(a1, a2), min(b1, b2)) for (a1, b1), (a2, b2) in zip(a, b)
+    )
+    if any(lo >= hi for lo, hi in out):
+        return None
+    return out
+
+
+def _covers_exactly(bounds, covers) -> bool:
+    """True iff the union of ``covers`` contains every point of
+    ``bounds``. Exact for any layout (including overlapping pieces):
+    coordinate-compress each dim, then require every elementary cell to
+    lie inside some cover. Cell counts are tiny — O(pieces) cuts/dim."""
+    import itertools
+
+    cuts = []
+    for d, (lo, hi) in enumerate(bounds):
+        pts = {lo, hi}
+        for c in covers:
+            a, b = c[d]
+            pts.add(min(max(a, lo), hi))
+            pts.add(min(max(b, lo), hi))
+        cuts.append(sorted(pts))
+    cells_per_dim = [list(zip(c[:-1], c[1:])) for c in cuts]
+    for cell in itertools.product(*cells_per_dim):
+        if not any(
+            all(
+                ca <= c_lo and c_hi <= cb
+                for (c_lo, c_hi), (ca, cb) in zip(cell, cov)
+            )
+            for cov in covers
+        ):
+            return False
+    return True
+
+
+def _route_region(bounds, piece_maps):
+    """Plan fetches for one needed region across donor hosts.
+
+    ``piece_maps``: {host_addr: [piece bounds...]} for this leaf. Returns
+    a list of (host, fetch_bounds) whose union covers ``bounds`` — a
+    single entry when one host covers the whole region (the matching-
+    layout fast path), per-piece intersections otherwise. Raises if the
+    hosts together cannot cover the region."""
+    for host, pieces in piece_maps.items():
+        for p in pieces:
+            if _intersect(bounds, p) == bounds:
+                return [(host, bounds)]
+    plan = []
+    seen = set()
+    for host, pieces in piece_maps.items():
+        for p in pieces:
+            inter = _intersect(bounds, p)
+            if inter is None or inter in seen:
+                continue
+            seen.add(inter)
+            plan.append((host, inter))
+    if not _covers_exactly(bounds, [b for _, b in plan]):
+        raise ValueError(
+            f"region {bounds} not covered by any donor host "
+            f"(hosts: {list(piece_maps)}) — resharded beyond the donor "
+            "group's union of shards"
+        )
+    return plan
+
+
 def recv_checkpoint_sharded(
     metadata: str,
     step: int,
@@ -601,7 +713,12 @@ def recv_checkpoint_sharded(
     devices hold (donor slices server-side) and assemble the result with
     the template's sharding via make_array_from_callback. Other leaves are
     fetched whole. The donor and healer must run the same model — leaf
-    paths are cross-checked against the donor's manifest."""
+    paths are cross-checked against the donor's manifest.
+
+    Multi-host fan-out: when a needed region is not fully held by the
+    primary donor host, the manifest's ``peers`` addresses are consulted
+    (their manifests fetched once) and each region — split per piece when
+    it spans hosts — is fetched from a host that owns it."""
     import jax
 
     manifest = fetch_manifest(metadata, step, timeout=timeout)
@@ -620,9 +737,54 @@ def recv_checkpoint_sharded(
                 f"{entry['path']!r}"
             )
 
-    # Plan all fetches first (unique shard slices per leaf), pull them in
-    # parallel, then assemble on-device.
-    plans = []  # (leaf_index, entry, tleaf, {norm_index: None-or-bytes})
+    # Per-host piece maps, lazily extended with peer manifests only if
+    # some region is not covered by the primary host.
+    manifests = {metadata: manifest}
+    peers_left = [p for p in manifest.get("peers", []) if p != metadata]
+
+    def _piece_maps(leaf_idx: int, shape) -> dict:
+        full = tuple((0, d) for d in shape)
+        out = {}
+        for host, m in manifests.items():
+            entry = m["leaves"][leaf_idx]
+            out[host] = [
+                tuple(tuple(b) for b in p)
+                for p in entry.get("pieces", [full])
+            ]
+        return out
+
+    def _plan_region(leaf_idx, shape, bounds):
+        try:
+            return _route_region(bounds, _piece_maps(leaf_idx, shape))
+        except ValueError:
+            # pull all peer manifests (once, in parallel — a serial walk
+            # would stall recovery by a full RTT per donor host) and
+            # retry before giving up
+            if peers_left:
+                def _pull(peer):
+                    try:
+                        return peer, fetch_manifest(
+                            peer, step, timeout=timeout
+                        )
+                    except Exception as e:  # noqa: BLE001 — a dead peer
+                        # only narrows coverage; the final route raises
+                        # if coverage stays short
+                        logger.warning(
+                            "peer manifest fetch failed %s: %s", peer, e
+                        )
+                        return peer, None
+                with ThreadPoolExecutor(
+                    max_workers=max(1, min(len(peers_left), parallel))
+                ) as pool:
+                    for peer, m in pool.map(_pull, peers_left):
+                        if m is not None:
+                            manifests[peer] = m
+                peers_left.clear()
+            return _route_region(bounds, _piece_maps(leaf_idx, shape))
+
+    # Plan all fetches first (unique shard slices per leaf, routed to the
+    # owning host), pull them in parallel, then assemble on-device.
+    plans = []  # (leaf_index, entry, tleaf, {bounds: [(host, sub)...]})
     for i, ((kp, tleaf), entry) in enumerate(zip(t_flat, entries)):
         if entry["kind"] == "ndarray" and isinstance(tleaf, jax.Array):
             shape = tuple(entry["shape"])
@@ -631,45 +793,70 @@ def recv_checkpoint_sharded(
                     f"shape mismatch at {entry['path']}: template "
                     f"{tuple(tleaf.shape)} vs donor {shape}"
                 )
+            if str(np.dtype(tleaf.dtype)) != entry["dtype"]:
+                # mirror the shape check: a donor/healer dtype skew must
+                # fail loudly, not heal with a silent precision change
+                raise ValueError(
+                    f"dtype mismatch at {entry['path']}: template "
+                    f"{np.dtype(tleaf.dtype)} vs donor {entry['dtype']}"
+                )
             idx_map = tleaf.sharding.addressable_devices_indices_map(shape)
-            unique = {_normalize_index(ix, shape): None
-                      for ix in idx_map.values()}
-            plans.append((i, entry, tleaf, unique))
+            unique = {
+                _normalize_index(ix, shape): None
+                for ix in idx_map.values()
+            }
+            routed = {
+                b: _plan_region(i, shape, b) for b in unique
+            }
+            plans.append((i, entry, tleaf, routed))
         else:
             plans.append((i, entry, tleaf, None))
 
     def _fetch(job):
-        i, bounds = job
+        host, i, bounds = job
         if bounds is None:
-            return fetch_leaf(metadata, step, i, timeout=timeout)
+            return fetch_leaf(host, step, i, timeout=timeout)
         return fetch_leaf(
-            metadata, step, i, slices=_bounds_to_slices(bounds),
+            host, step, i, slices=_bounds_to_slices(bounds),
             timeout=timeout,
         )
 
-    jobs = []
-    for i, entry, tleaf, unique in plans:
-        if unique is None:
-            jobs.append((i, None))
+    jobs = set()
+    for i, entry, tleaf, routed in plans:
+        if routed is None:
+            jobs.add((metadata, i, None))
         else:
-            jobs.extend((i, ix) for ix in unique)
+            for sub in routed.values():
+                jobs.update((host, i, b) for host, b in sub)
+    jobs = sorted(jobs)
     with ThreadPoolExecutor(max_workers=max(1, parallel)) as pool:
         fetched = list(pool.map(_fetch, jobs))
-
     results_by_job = dict(zip(jobs, fetched))
+
     leaves = []
-    for i, entry, tleaf, unique in plans:
-        if unique is None:
-            leaves.append(results_by_job[(i, None)])
+    for i, entry, tleaf, routed in plans:
+        if routed is None:
+            leaves.append(results_by_job[(metadata, i, None)])
             continue
-        dtype = tleaf.dtype
-        shards = {
-            ix: np.asarray(results_by_job[(i, ix)]).astype(
-                dtype, copy=False
-            )
-            for ix in unique
-        }
         shape = tuple(entry["shape"])
+        shards = {}
+        for bounds, sub in routed.items():
+            if len(sub) == 1 and sub[0][1] == bounds:
+                host, _ = sub[0]
+                arr = results_by_job[(host, i, bounds)]
+            else:  # spans hosts: assemble the region from its pieces
+                arr = np.empty(
+                    tuple(b - a for a, b in bounds),
+                    dtype=_dtype_from_str(entry["dtype"]),
+                )
+                for host, piece_b in sub:
+                    dst = tuple(
+                        slice(a - ra, b - ra)
+                        for (a, b), (ra, _) in zip(piece_b, bounds)
+                    )
+                    arr[dst] = results_by_job[(host, i, piece_b)]
+            # dtype equality is already enforced against the manifest
+            shards[bounds] = np.asarray(arr)
 
         def _cb(index, _shards=shards, _shape=shape):
             return _shards[_normalize_index(index, _shape)]

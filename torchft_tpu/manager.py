@@ -164,6 +164,18 @@ class Manager:
             self._store.set(MANAGER_ADDR_KEY, self._manager.address())
             self._store.set(REPLICA_ID_KEY, replica_id)
 
+        # Every rank advertises its checkpoint server on the group store so
+        # a donor's manifests can carry peer addresses — the multi-host
+        # fan-out that lets a healer fetch regions this host's shards
+        # don't cover from the rank that owns them.
+        self._store.set(
+            f"checkpoint_addr_{self._rank}",
+            self._checkpoint_transport.metadata(),
+        )
+        self._ckpt_peers_set = self._world_size <= 1 or not hasattr(
+            self._checkpoint_transport, "set_peers"
+        )
+
         addr = self._store.wait(
             MANAGER_ADDR_KEY, timeout=self._connect_timeout
         ).decode()
@@ -438,6 +450,24 @@ class Manager:
                 self._logger.info(
                     f"peers need recovery from us {quorum.recover_dst_ranks}"
                 )
+                if not self._ckpt_peers_set:
+                    try:
+                        self._checkpoint_transport.set_peers([
+                            self._store.wait(
+                                f"checkpoint_addr_{r}",
+                                timeout=self._connect_timeout,
+                            ).decode()
+                            for r in range(self._world_size)
+                            if r != self._rank
+                        ])
+                        self._ckpt_peers_set = True
+                    except Exception as e:  # noqa: BLE001 — fan-out is an
+                        # enhancement; healing proceeds without peers and
+                        # the NEXT donor event retries discovery (a peer
+                        # may simply not have registered yet)
+                        self._logger.warn(
+                            f"checkpoint peer discovery failed: {e}"
+                        )
                 self._checkpoint_transport.send_checkpoint(
                     dst_ranks=quorum.recover_dst_ranks,
                     step=quorum.max_step,
