@@ -7,9 +7,11 @@
 // (malloc'd message, NULL on success); timeout errors are prefixed
 // "TIMEOUT: " so the Python layer can raise TimeoutError, mirroring the
 // Status→PyErr mapping at reference lib.rs:321-339.
+#include <atomic>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <random>
 #include <string>
 
 #include "ftjson.h"
@@ -34,6 +36,16 @@ struct ClientHandle {
   std::string host;
   int port;
   std::string addr;
+  // Per-logical-RPC attempt ids for the ShouldCommit barrier: attached
+  // ONCE per call (before the pooled-connection send/retry loop), so a
+  // transport-level resend carries the SAME id and the server can replay
+  // the decided round's answer instead of counting a duplicate vote.
+  // Random base so a recreated client can't collide with its ancestor.
+  int64_t attempt_base = []() {
+    std::random_device rd;
+    return (static_cast<int64_t>(rd()) << 20) & 0x7fffffffffffff00LL;
+  }();
+  std::atomic<int64_t> attempt_seq{0};
 };
 
 // POST helper that converts HTTP/transport failures into err strings.
@@ -210,6 +222,7 @@ int ft_manager_client_should_commit(void* handle, int64_t rank, int64_t step,
   req["rank"] = rank;
   req["step"] = step;
   req["should_commit"] = should_commit != 0;
+  req["attempt"] = c->attempt_base + c->attempt_seq.fetch_add(1);
   std::string out;
   if (!client_post(c, "/torchft.ManagerService/ShouldCommit",
                    ftjson::Value(req).dump(),

@@ -339,7 +339,12 @@ bool exchange_once(int fd, const std::string& method, const std::string& host,
     result->error = "no response (recv failed or timed out)";
     result->timed_out = (now_ms() >= deadline_ms);
     // EOF with zero bytes on a reused conn = stale pooled socket; a
-    // timeout is a real deadline failure, never retried.
+    // timeout is a real deadline failure, never retried. The one-shot
+    // resend can double-EXECUTE a POST the server processed before the
+    // connection died, so every pooled endpoint must be idempotent:
+    // quorum/heartbeat/metadata are rank-keyed set inserts or reads, and
+    // the ShouldCommit barrier is step-keyed with a cached-decision
+    // replay path (manager.cc handle_should_commit) for exactly this.
     *retryable = reused && !result->timed_out;
     return false;
   }

@@ -204,26 +204,74 @@ Response ManagerServer::handle_checkpoint_metadata(const Request& req) {
 }
 
 Response ManagerServer::handle_should_commit(const Request& req) {
-  int64_t rank;
+  int64_t rank, step, attempt;
   bool should_commit;
   try {
     auto body = ftjson::Value::parse(req.body);
     rank = body.get_int("rank");
+    step = body.get_int("step");
     should_commit = body.get_bool("should_commit");
+    attempt = body.get_int("attempt", -1);
   } catch (const std::exception& e) {
     return Response{400, "application/json",
                     std::string("{\"error\":\"") + e.what() + "\"}"};
   }
 
   std::unique_lock<std::mutex> lk(mu_);
+  // Idempotent replay: the pooled-connection client attaches a unique
+  // attempt id per LOGICAL vote, so a transport resend (reply lost after
+  // the server processed the POST) carries the id of a vote that already
+  // reached a decision — hand that round's cached decision back instead
+  // of counting a duplicate vote into a later round. Unlike step-keying
+  // alone this also covers FALSE rounds, whose step is legitimately
+  // re-voted afterwards.
+  if (attempt >= 0) {
+    auto it = decided_attempts_.find(rank);
+    if (it != decided_attempts_.end() && it->second.first == attempt) {
+      ftjson::Object out;
+      out["should_commit"] = it->second.second;
+      return Response{200, "application/json",
+                      ftjson::Value(out).dump()};
+    }
+  }
+  if (step < last_commit_round_step_ ||
+      (step == last_commit_round_step_ && latest_decision_)) {
+    // Older than the last decided round, or a fresh vote for a step the
+    // group already committed past: protocol violation, reject loudly.
+    // (A FALSE decision leaves the step re-votable — that path falls
+    // through as a fresh round.)
+    return Response{409, "application/json",
+                    "{\"error\":\"stale should_commit vote\"}"};
+  }
+  if (commit_count_.empty()) {
+    commit_round_step_ = step;
+  } else if (step < commit_round_step_) {
+    return Response{409, "application/json",
+                    "{\"error\":\"stale should_commit vote (round is "
+                    "ahead)\"}"};
+  } else if (step > commit_round_step_) {
+    // The open round is abandoned garbage: a voter timed out and the
+    // group moved on (e.g. healed past it). Drop it so it can't poison
+    // the barrier forever; its blocked waiters are released when THIS
+    // round decides and then told their round was abandoned.
+    commit_count_.clear();
+    commit_failures_.clear();
+    round_attempts_.clear();
+    commit_round_step_ = step;
+  }
   if (!should_commit) commit_failures_.insert(rank);
   commit_count_.insert(rank);
+  if (attempt >= 0) round_attempts_[rank] = attempt;
   uint64_t seen = commit_seq_;
 
   if (commit_count_.size() >= opts_.world_size) {
     latest_decision_ = commit_failures_.empty();
+    last_commit_round_step_ = commit_round_step_;
+    for (const auto& ra : round_attempts_)
+      decided_attempts_[ra.first] = {ra.second, latest_decision_};
     commit_count_.clear();
     commit_failures_.clear();
+    round_attempts_.clear();
     commit_seq_ += 1;
     cv_.notify_all();
   } else {
@@ -241,6 +289,14 @@ Response ManagerServer::handle_should_commit(const Request& req) {
     if (stopping_) {
       return Response{503, "application/json",
                       "{\"error\":\"manager shutting down\"}"};
+    }
+    if (last_commit_round_step_ != step) {
+      // Woken by a LATER round's decision: our round was abandoned
+      // (dropped when a newer-step vote arrived). That decision is not
+      // ours to consume — fail so the caller re-votes at its current
+      // step.
+      return Response{409, "application/json",
+                      "{\"error\":\"should_commit round abandoned\"}"};
     }
   }
 

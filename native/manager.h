@@ -79,11 +79,22 @@ class ManagerServer {
   uint64_t quorum_seq_ = 0;
   std::optional<ftquorum::QuorumInfo> latest_quorum_;
 
-  // ShouldCommit barrier state.
+  // ShouldCommit barrier state. Rounds are keyed by step so a retried
+  // vote (pooled-connection resend after a lost reply) can never leak
+  // into the NEXT round's barrier: a replayed vote for the last decided
+  // step gets that round's cached decision back, and anything older is
+  // rejected as stale.
   std::set<int64_t> commit_count_;
   std::set<int64_t> commit_failures_;
   uint64_t commit_seq_ = 0;
   bool latest_decision_ = false;
+  int64_t commit_round_step_ = -1;       // step of the in-progress round
+  int64_t last_commit_round_step_ = -1;  // step of the last decided round
+  // attempt ids: per-rank id of the vote in the open round, and per-rank
+  // (id, decision) of each rank's last DECIDED vote — the replay cache
+  // that makes the pooled-connection resend of a vote idempotent.
+  std::map<int64_t, int64_t> round_attempts_;
+  std::map<int64_t, std::pair<int64_t, bool>> decided_attempts_;
 };
 
 }  // namespace ftmanager

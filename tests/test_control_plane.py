@@ -191,6 +191,90 @@ def test_should_commit_unanimous_and_veto(lighthouse) -> None:
         mgr.shutdown()
 
 
+def _raw_vote(addr, rank, step, ok, attempt, timeout=10.0):
+    """Drive the ShouldCommit wire protocol directly, with an explicit
+    attempt id — the only way to simulate a transport-level RESEND (the
+    real client mints a fresh id per logical call)."""
+    import json as _json
+    import urllib.request
+
+    req = urllib.request.Request(
+        addr + "/torchft.ManagerService/ShouldCommit",
+        data=_json.dumps({
+            "rank": rank, "step": step, "should_commit": ok,
+            "attempt": attempt,
+        }).encode(),
+        headers={
+            "x-timeout-ms": str(int(timeout * 1000)),
+            "Content-Type": "application/json",
+        },
+    )
+    with urllib.request.urlopen(req, timeout=timeout + 5) as r:
+        return _json.loads(r.read())["should_commit"]
+
+
+def test_should_commit_replay_and_stale_votes(lighthouse) -> None:
+    # A vote resent after a lost reply (pooled-connection retry) carries
+    # the SAME attempt id and must get its own round's cached decision —
+    # for TRUE and FALSE rounds alike — never be counted into a later
+    # round's barrier. Fresh votes for already-committed steps are stale;
+    # a half-round abandoned by a timeout is drained by newer-step votes.
+    import urllib.error
+
+    mgr = _make_manager(lighthouse, "rep_0", world_size=2)
+    try:
+        addr = mgr.address()
+        # 4 workers: the stranded step-3 vote must not starve the step-5
+        # pair out of the pool
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            f0 = pool.submit(_raw_vote, addr, 0, 1, True, 100)
+            f1 = pool.submit(_raw_vote, addr, 1, 1, True, 101)
+            assert f0.result(timeout=15) is True
+            assert f1.result(timeout=15) is True
+
+            # transport resend (same attempt id): cached decision, no wait
+            assert _raw_vote(addr, 0, 1, True, 100, timeout=2.0) is True
+
+            # a FRESH vote for the committed step is a protocol violation
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _raw_vote(addr, 0, 1, True, 102, timeout=2.0)
+            assert ei.value.code == 409
+            # an older vote likewise
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _raw_vote(addr, 0, 0, True, 103, timeout=2.0)
+            assert ei.value.code == 409
+
+            # FALSE round: the resend must replay FALSE, and the same
+            # step must still be re-votable as a fresh barrier
+            f0 = pool.submit(_raw_vote, addr, 0, 2, True, 110)
+            f1 = pool.submit(_raw_vote, addr, 1, 2, False, 111)
+            assert f0.result(timeout=15) is False
+            assert f1.result(timeout=15) is False
+            assert _raw_vote(addr, 1, 2, False, 111, timeout=2.0) is False
+            f0 = pool.submit(_raw_vote, addr, 0, 2, True, 112)
+            f1 = pool.submit(_raw_vote, addr, 1, 2, True, 113)
+            assert f0.result(timeout=15) is True
+            assert f1.result(timeout=15) is True
+
+            # abandoned half-round: rank 0 opens step 3 and blocks; the
+            # group moves on to step 5 (heal semantics). The new round
+            # must complete — not 409 forever — and the stranded step-3
+            # voter must be told its round was abandoned.
+            f_stranded = pool.submit(
+                _raw_vote, addr, 0, 3, True, 120, 8.0
+            )
+            time.sleep(0.3)  # let the step-3 vote open its round
+            f0 = pool.submit(_raw_vote, addr, 0, 5, True, 121)
+            f1 = pool.submit(_raw_vote, addr, 1, 5, True, 122)
+            assert f0.result(timeout=15) is True
+            assert f1.result(timeout=15) is True
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                f_stranded.result(timeout=15)
+            assert ei.value.code == 409
+    finally:
+        mgr.shutdown()
+
+
 def test_checkpoint_metadata_roundtrip(lighthouse) -> None:
     mgr = _make_manager(lighthouse, "rep_0")
     try:

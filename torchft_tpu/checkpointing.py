@@ -691,6 +691,10 @@ def _route_region(bounds, piece_maps):
             if inter is None or inter in seen:
                 continue
             seen.add(inter)
+            if plan and _covers_exactly(inter, [b for _, b in plan]):
+                # another host's pieces already supply every byte of this
+                # intersection — don't fetch it twice
+                continue
             plan.append((host, inter))
     if not _covers_exactly(bounds, [b for _, b in plan]):
         raise ValueError(

@@ -637,7 +637,16 @@ class _Lane:
         if reduce_fn is None:
             raise ValueError(f"unsupported reduce op: {p.op}")
 
+        # Reduce-scatter hops carry PARTIAL SUMS: re-encoding them with a
+        # lossy codec at every hop would compound quantization error
+        # linearly with world size. So the reduce-scatter phase always
+        # runs uncompressed; the configured codec applies only to the
+        # all-gather phase, where each completed chunk is encoded exactly
+        # once by its owner — the same single-quantization error bound as
+        # the star path (at the cost of compressing only half the wire
+        # traffic).
         codec = self._codec
+        rs_codec = _NoCodec()
         out = [np.array(np.ascontiguousarray(a), copy=True) for a in p.arrays]
         flats = [a.reshape(-1) for a in out]
 
@@ -648,8 +657,8 @@ class _Lane:
                 views.append(f[s:e])
             return views
 
-        def expect_len(views: List[np.ndarray]) -> int:
-            return sum(codec.wire_nbytes(v) for v in views)
+        def expect_len(codec_, views: List[np.ndarray]) -> int:
+            return sum(codec_.wire_nbytes(v) for v in views)
 
         # reduce-scatter: after step s, chunk (r - s) was sent onward and
         # chunk (r - s - 1) absorbed; rank r ends owning chunk (r + 1) % n.
@@ -659,13 +668,13 @@ class _Lane:
             send_views = chunk_views(send_c)
             recv_views = chunk_views(recv_c)
             data = self._ring_sendrecv(
-                _OP_ALLREDUCE, step, codec.encode_views(send_views)
+                _OP_ALLREDUCE, step, rs_codec.encode_views(send_views)
             )
-            if len(data) != expect_len(recv_views):
+            if len(data) != expect_len(rs_codec, recv_views):
                 raise ConnectionError(
                     "ring allreduce chunk size mismatch (divergent shapes?)"
                 )
-            codec.decode_into(data, recv_views, reduce_fn)
+            rs_codec.decode_into(data, recv_views, reduce_fn)
 
         # All-gather of the completed chunks. Each chunk is encoded ONCE
         # by its owner and the received bytes are forwarded VERBATIM, so
@@ -681,7 +690,7 @@ class _Lane:
             recv_c = (r - step) % n
             recv_views = chunk_views(recv_c)
             data = self._ring_sendrecv(_OP_ALLREDUCE, n - 1 + step, carry)
-            if len(data) != expect_len(recv_views):
+            if len(data) != expect_len(codec, recv_views):
                 raise ConnectionError(
                     "ring allreduce chunk size mismatch (divergent shapes?)"
                 )
