@@ -169,21 +169,72 @@ def _flops_per_step(cfg, n_params: int, seq_len: int,
 
 
 def _maybe_pick_flash(cfg, params, tokens, targets, tx):
-    """A/B the pallas flash kernel vs the XLA attention path on this
-    backend. Returns (attn_fn or None, label, speedup, max_err)."""
+    """A/B the pallas flash kernel (sweeping block sizes) vs the XLA
+    attention path on this backend. Returns (attn_fn or None, label,
+    speedup, max_err)."""
     import jax
     import numpy as np
 
     from torchft_tpu.models import make_train_step, forward
     from torchft_tpu.ops.flash import flash_attention
 
-    def flash_fn(q, k, v):
-        return flash_attention(q, k, v, causal=True)
+    seq = tokens.shape[1]
+    # Mosaic tiling candidates; best block shape is model/chip dependent,
+    # so measure rather than guess. BENCH_FLASH_BLOCKS="bq:bk,bq:bk,..."
+    # overrides. A malformed override must degrade to the defaults, never
+    # cost the run its artifact.
+    candidates = [(128, 128), (256, 256), (256, 512)]
+    blocks_env = os.environ.get("BENCH_FLASH_BLOCKS")
+    if blocks_env:
+        try:
+            parsed = [
+                tuple(int(x) for x in spec.split(":"))
+                for spec in blocks_env.split(",") if spec.strip()
+            ]
+            if not all(len(p) == 2 for p in parsed):
+                raise ValueError("each spec must be bq:bk")
+            candidates = parsed
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(
+                f"bench: bad BENCH_FLASH_BLOCKS {blocks_env!r} ({e}); "
+                "using defaults\n"
+            )
+    # flash_attention clamps blocks to the sequence — dedupe on the
+    # CLAMPED shape so identical configs aren't timed repeatedly (and the
+    # reported label names a shape that actually ran)
+    seen = set()
+    clamped = []
+    for bq, bk in candidates:
+        c = (min(bq, seq), min(bk, seq))
+        if c in seen or seq % c[0] or seq % c[1]:
+            continue
+        seen.add(c)
+        clamped.append(c)
+    candidates = clamped or [(min(128, seq), min(128, seq))]
+
+    def make_flash_fn(bq, bk):
+        return lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=bq, block_k=bk
+        )
 
     try:
-        # numerics cross-check on logits first
+        # numerics cross-check on logits first (the kernel math is shared
+        # across block shapes; use the first candidate that compiles)
         logits_xla = forward(cfg, params, tokens)
-        logits_fl = forward(cfg, params, tokens, attn_fn=flash_fn)
+        logits_fl = None
+        for bq, bk in candidates:
+            try:
+                logits_fl = forward(
+                    cfg, params, tokens, attn_fn=make_flash_fn(bq, bk)
+                )
+                break
+            except Exception as e:  # noqa: BLE001 — e.g. VMEM overflow
+                sys.stderr.write(
+                    f"bench: flash block ({bq},{bk}) numerics probe "
+                    f"failed: {e}\n"
+                )
+        if logits_fl is None:
+            return None, "xla", 0.0, float("nan")
         err = float(
             jax.numpy.max(jax.numpy.abs(logits_xla - logits_fl))
         )
@@ -204,10 +255,34 @@ def _maybe_pick_flash(cfg, params, tokens, targets, tx):
             return time.perf_counter() - t0
 
         t_xla = time_step(None)
-        t_flash = time_step(flash_fn)
-        if t_flash < t_xla:
-            return flash_fn, "flash", t_xla / t_flash, err
-        return None, "xla", t_xla / t_flash, err
+        best = None  # (time, (bq, bk))
+        for bq, bk in candidates:
+            try:
+                t = time_step(make_flash_fn(bq, bk))
+            except Exception as e:  # noqa: BLE001 — e.g. VMEM overflow
+                # at large blocks; smaller candidates may still win
+                sys.stderr.write(
+                    f"bench: flash block ({bq},{bk}) failed: {e}\n"
+                )
+                continue
+            sys.stderr.write(
+                f"bench: flash block ({bq},{bk}): {t:.3f}s vs xla "
+                f"{t_xla:.3f}s\n"
+            )
+            if best is None or t < best[0]:
+                best = (t, (bq, bk))
+        if best is not None and best[0] < t_xla:
+            bq, bk = best[1]
+            return (
+                make_flash_fn(bq, bk),
+                f"flash[{bq}x{bk}]",
+                t_xla / best[0],
+                err,
+            )
+        return (
+            None, "xla",
+            0.0 if best is None else t_xla / best[0], err,
+        )
     except Exception as e:  # noqa: BLE001 — flash is an optimization only
         sys.stderr.write(f"bench: flash A/B failed, using XLA path: {e}\n")
         return None, "xla", 0.0, float("nan")

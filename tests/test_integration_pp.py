@@ -1,0 +1,230 @@
+"""PP x FT end-to-end: a GPipe pipeline as the in-group mesh, composed
+with the Manager fault-tolerance loop, including kill + sharded heal.
+
+Completes the in-group axis set against the FT runtime (HSDP x FT and
+TP x FT are tests/test_integration_hsdp.py and test_integration_tp.py):
+each replica group runs a 4-stage microbatched pipeline over its own
+4-device ``{"stage": 4}`` mesh — stage-stacked parameters sharded on the
+leading dim, gradients obtained by differentiating THROUGH the pipeline
+(parallel/pipeline.py) — while cross-group averaging runs through the
+Manager/DCN transport. One group is killed mid-run and heals through the
+sharding-aware checkpoint path onto its own stage-sharded layout.
+"""
+
+import logging
+import threading
+import time
+from typing import Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from torchft_tpu.checkpointing import CheckpointServer
+from torchft_tpu.comm.store import StoreServer
+from torchft_tpu.comm.transport import TcpCommContext
+from torchft_tpu.control import Lighthouse
+from torchft_tpu.manager import Manager
+from torchft_tpu.parallel import ft_mesh
+from torchft_tpu.parallel.pipeline import (
+    make_pipeline,
+    merge_microbatches,
+    split_microbatches,
+    stack_stage_params,
+)
+
+logger = logging.getLogger(__name__)
+
+S, D, BATCH, M = 4, 6, 8, 4  # stages, width, batch, microbatches
+
+
+def _stage_fn(params, h):
+    return jnp.tanh(h @ params["w"] + params["b"])
+
+
+def group_mesh(group: int):
+    devs = jax.devices()[group * 4: group * 4 + 4]
+    return ft_mesh({"stage": S}, devices=devs)
+
+
+def make_stacked_params(seed: float, mesh):
+    """Stage-stacked params, leading dim sharded over the stage axis —
+    each pipeline device holds exactly its stage's weights."""
+    stages = [
+        {
+            "w": jnp.full((D, D), seed / (i + 1), jnp.float32),
+            "b": jnp.full((D,), seed / 10.0, jnp.float32),
+        }
+        for i in range(S)
+    ]
+    stacked = stack_stage_params(stages)
+    return jax.tree_util.tree_map(
+        lambda l: jax.device_put(
+            l, NamedSharding(mesh, P("stage", *([None] * (l.ndim - 1))))
+        ),
+        stacked,
+    )
+
+
+class _Killed(Exception):
+    pass
+
+
+class _PpReplica:
+    def __init__(self, harness, group: int, lighthouse_addr: str,
+                 fail_at_step: int = -1):
+        self.harness = harness
+        self.group = group
+        self.lighthouse_addr = lighthouse_addr
+        self.fail_at_step = fail_at_step
+        self.history: Dict[int, np.ndarray] = {}
+        self.healed_shardings_ok = True
+        self.healed = False
+
+    def run(self) -> None:
+        restarted = False
+        while not self.harness["stop"].is_set():
+            try:
+                self._main(restarted)
+                return
+            except _Killed:
+                logger.warning("pp group %d restarting after kill",
+                               self.group)
+                restarted = True
+                continue
+
+    def _main(self, restarted: bool) -> None:
+        mesh = group_mesh(self.group)
+        store = StoreServer()
+        seed = 99.0 if restarted else 1.0
+        holder = {"params": make_stacked_params(seed, mesh)}
+
+        def state_dict():
+            return {"params": holder["params"]}
+
+        def load_state_dict(sd):
+            for leaf in jax.tree_util.tree_leaves(sd["params"]):
+                if not isinstance(leaf, jax.Array) or (
+                    leaf.sharding.spec[0] != "stage"
+                ):
+                    self.healed_shardings_ok = False
+            holder["params"] = sd["params"]
+            self.healed = True
+
+        transport = CheckpointServer(
+            timeout=5.0, template_fn=lambda: {
+                "user": state_dict(),
+                "torchft": {"step": 0, "batches_committed": 0},
+            },
+        )
+
+        pp = make_pipeline(mesh, _stage_fn)
+        x = jnp.ones((BATCH, D), jnp.float32)
+        mb = split_microbatches(x, M)
+
+        @jax.jit
+        def grad_step(params):
+            def loss_fn(p):
+                out = merge_microbatches(pp(p, mb))
+                return jnp.mean((out - 1.0) ** 2)
+
+            return jax.value_and_grad(loss_fn)(params)
+
+        manager = Manager(
+            comm=TcpCommContext(timeout=5.0),
+            load_state_dict=load_state_dict,
+            state_dict=state_dict,
+            checkpoint_transport=transport,
+            min_replica_size=1,
+            use_async_quorum=True,
+            timeout=10.0, quorum_timeout=10.0, connect_timeout=10.0,
+            rank=0, world_size=1,
+            store_addr=store.addr,
+            lighthouse_addr=self.lighthouse_addr,
+            replica_id=f"pp_{self.group}_",
+            heartbeat_interval=0.05,
+        )
+        try:
+            while not self.harness["stop"].is_set():
+                if (not restarted
+                        and manager.current_step() == self.fail_at_step):
+                    raise _Killed()
+                try:
+                    manager.start_quorum()
+                except (TimeoutError, RuntimeError) as e:
+                    logger.info("quorum retry: %s", e)
+                    continue
+                with mesh:
+                    loss, grads = grad_step(holder["params"])
+                avg = manager.allreduce_pytree(grads).result(timeout=20)
+                if manager.should_commit():
+                    new_params = jax.tree_util.tree_map(
+                        lambda p, g: jax.device_put(
+                            p - 0.1 * jnp.asarray(np.asarray(g), p.dtype),
+                            p.sharding,
+                        ),
+                        holder["params"], avg,
+                    )
+                    holder["params"] = new_params
+                    committed = manager.current_step()
+                    self.history[committed] = np.asarray(
+                        holder["params"]["w"]
+                    )
+                    with self.harness["lock"]:
+                        counts = self.harness["commits"]
+                        counts[self.group] = counts.get(self.group, 0) + 1
+                        if all(
+                            counts.get(g, 0) >= self.harness["target"]
+                            for g in range(2)
+                        ):
+                            self.harness["stop"].set()
+                else:
+                    time.sleep(0.01)
+        finally:
+            manager.shutdown(wait=False)
+            store.shutdown()
+
+
+def test_pp_ft_kill_and_sharded_heal() -> None:
+    lighthouse = Lighthouse(
+        min_replicas=1, join_timeout_ms=300, heartbeat_timeout_ms=1000
+    )
+    harness = {
+        "stop": threading.Event(),
+        "lock": threading.Lock(),
+        "commits": {},
+        "target": 6,
+    }
+    replicas = [
+        _PpReplica(harness, 0, lighthouse.address()),
+        _PpReplica(harness, 1, lighthouse.address(), fail_at_step=3),
+    ]
+    threads = [
+        threading.Thread(target=r.run, name=f"pp{r.group}", daemon=True)
+        for r in replicas
+    ]
+    deadline = time.time() + 150
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(max(1.0, deadline - time.time()))
+    harness["stop"].set()
+    lighthouse.shutdown()
+
+    assert harness["commits"].get(0, 0) >= harness["target"]
+    assert harness["commits"].get(1, 0) >= harness["target"]
+    assert replicas[1].healed, "killed group never healed"
+    assert all(r.healed_shardings_ok for r in replicas)
+
+    common = sorted(set(replicas[0].history) & set(replicas[1].history))
+    assert len(common) >= 3, f"too few common steps: {common}"
+    post_heal = [s for s in common if s > 4]
+    assert post_heal, "no common steps after the kill/heal"
+    for s in common:
+        np.testing.assert_allclose(
+            replicas[0].history[s], replicas[1].history[s],
+            rtol=1e-5, atol=1e-6,
+            err_msg=f"divergence at step {s}",
+        )

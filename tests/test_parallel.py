@@ -181,6 +181,59 @@ def test_ring_attention_matches_reference(causal) -> None:
     assert out.sharding.spec == P(None, "seq", None, None)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_flash_blocks_matches_reference(
+    causal, monkeypatch
+) -> None:
+    # flash-block ring (pallas local blocks + logaddexp stream merge,
+    # future blocks skipped at block granularity) must be EXACT vs dense
+    # attention, like the einsum ring. Interpret mode: no TPU in tests.
+    monkeypatch.setenv("TORCHFT_TPU_PALLAS_INTERPRET", "1")
+    mesh = ft_mesh({"seq": 4}, devices=jax.devices()[:4])
+    B, S, H, D = 2, 64, 2, 16
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), dtype=jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, H, D)), dtype=jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, H, D)), dtype=jnp.float32)
+    spec = NamedSharding(mesh, P(None, "seq", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+
+    ring = jax.jit(make_ring_attention(
+        mesh, "seq", causal=causal, block_impl="flash",
+        block_q=8, block_k=8,
+    ))
+    out = ring(qs, ks, vs)
+    expected = _reference_attention(q, k, v, causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5
+    )
+    assert out.sharding.spec == P(None, "seq", None, None)
+
+
+def test_ring_attention_flash_blocks_match_einsum_blocks(
+    monkeypatch,
+) -> None:
+    # the two block implementations are interchangeable numerically
+    monkeypatch.setenv("TORCHFT_TPU_PALLAS_INTERPRET", "1")
+    mesh = ft_mesh({"seq": 8})
+    B, S, H, D = 1, 64, 2, 8
+    rng = np.random.default_rng(8)
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), dtype=jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, H, D)), dtype=jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, H, D)), dtype=jnp.float32)
+    spec = NamedSharding(mesh, P(None, "seq", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    out_e = jax.jit(make_ring_attention(mesh, "seq", causal=True))(
+        qs, ks, vs
+    )
+    out_f = jax.jit(make_ring_attention(
+        mesh, "seq", causal=True, block_impl="flash", block_q=8, block_k=8,
+    ))(qs, ks, vs)
+    np.testing.assert_allclose(
+        np.asarray(out_e), np.asarray(out_f), atol=2e-5, rtol=2e-5
+    )
+
+
 def test_ring_attention_long_context_grad() -> None:
     # differentiate through the ring (training path), check vs reference
     mesh = ft_mesh({"seq": 8})

@@ -34,7 +34,7 @@ try:  # TPU-specific bits are unavailable when lowering for CPU interpret
 except ImportError:  # pragma: no cover
     pltpu = None
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse"]
 
 _NEG_INF = -1e30  # avoid nan from (-inf) - (-inf) in the running max
 
@@ -576,15 +576,10 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = True,
-                    scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
-                    interpret: Optional[bool] = None):
-    """[B, S, H, D] flash attention (pallas on TPU).
-
-    Sequence length must be a multiple of the block sizes (pad upstream if
-    needed; the model configs here use powers of two).
-    """
+def _bshd_prologue(q, scale, block_q, block_k, interpret):
+    """Shared [B,S,H,D]-surface plumbing: scale default, interpret env
+    read, block clamping, divisibility validation, and the
+    [B,S,H,D] <-> [B*H,S,D] layout pair. One place, two wrappers."""
     b, s, h, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
@@ -598,9 +593,51 @@ def flash_attention(q, k, v, causal: bool = True,
             f"({block_q}, {block_k})"
         )
 
-    def _merge(x):  # [B,S,H,D] -> [B*H, S, D]
+    def merge(x):  # [B,S,H,D] -> [B*H, S, D]
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    out = _flash(_merge(q), _merge(k), _merge(v), causal, float(scale),
+    def unmerge(x):  # [B*H, S, D] -> [B,S,H,D]
+        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return float(scale), block_q, block_k, interpret, merge, unmerge
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: Optional[bool] = None):
+    """Forward-only flash attention returning ``(out, lse)`` with
+    out [B, S, H, D] and lse [B, H, S] (log-sum-exp of the scaled scores,
+    max-folded). The lse output is what makes results MERGEABLE: two
+    attention results over disjoint key sets combine exactly via
+    ``lse' = logaddexp(lse_a, lse_b); out' = sum_i out_i * exp(lse_i -
+    lse')`` — the blockwise/ring/flash-decoding composition rule
+    (parallel/ring.py uses it for the flash-block ring path). No custom
+    VJP is defined for this surface; use ``flash_attention`` (or the
+    einsum ring path) where gradients are needed."""
+    b, s, h, _ = q.shape
+    scale, block_q, block_k, interpret, merge, unmerge = _bshd_prologue(
+        q, scale, block_q, block_k, interpret
+    )
+    out, lse = _flash_forward(
+        merge(q), merge(k), merge(v), causal, scale,
+        block_q, block_k, interpret,
+    )
+    return unmerge(out), lse.reshape(b, h, s)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """[B, S, H, D] flash attention (pallas on TPU).
+
+    Sequence length must be a multiple of the block sizes (pad upstream if
+    needed; the model configs here use powers of two).
+    """
+    scale, block_q, block_k, interpret, merge, unmerge = _bshd_prologue(
+        q, scale, block_q, block_k, interpret
+    )
+    out = _flash(merge(q), merge(k), merge(v), causal, scale,
                  block_q, block_k, interpret)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return unmerge(out)

@@ -102,14 +102,85 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
     return out.astype(q.dtype)
 
 
+def _ring_attention_sharded_flash(q, k, v, axis_name: str, causal: bool,
+                                  scale: Optional[float], block_q: int,
+                                  block_k: int):
+    """Flash-block ring body: each (q-block, kv-block) pair runs the
+    pallas flash kernel (ops/flash.py) instead of the einsum online
+    softmax, and the per-pair (out, lse) results merge exactly via the
+    logaddexp rule. Causality is handled at BLOCK granularity: a kv block
+    strictly in the future is skipped outright (lax.cond — no wasted MXU
+    work, the n/2 saving dense ring masking forfeits), the diagonal block
+    runs the causal kernel, past blocks run unmasked. Forward-optimized:
+    flash_attention_with_lse defines no VJP, so use the einsum path
+    (block_impl="einsum") for training."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from torchft_tpu.ops.flash import flash_attention_with_lse
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    eff_scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    o0 = jnp.zeros(q.shape, dtype=jnp.float32)
+    lse0 = jnp.full((b, h, s_local), -jnp.inf, dtype=jnp.float32)
+
+    def body(t, carry):
+        o_acc, lse_acc, k_t, v_t = carry
+        src = (idx - t) % n
+
+        def attend(causal_flag: bool):
+            return lambda: flash_attention_with_lse(
+                q, k_t, v_t, causal=causal_flag, scale=eff_scale,
+                block_q=block_q, block_k=block_k,
+            )
+
+        if causal:
+            o_t, lse_t = lax.cond(
+                src > idx,
+                lambda: (jnp.zeros(q.shape, q.dtype),
+                         jnp.full((b, h, s_local), -jnp.inf, jnp.float32)),
+                lambda: lax.cond(
+                    src == idx, attend(True), attend(False)
+                ),
+            )
+        else:
+            o_t, lse_t = attend(False)()
+        # exact two-stream merge (flash-decoding rule)
+        lse_new = jnp.logaddexp(lse_acc, lse_t)
+        dead = jnp.isneginf(lse_new)
+        w_acc = jnp.where(dead, 0.0, jnp.exp(lse_acc - lse_new))
+        w_t = jnp.where(dead, 0.0, jnp.exp(lse_t - lse_new))
+        o_new = (
+            o_acc * w_acc.transpose(0, 2, 1)[..., None]
+            + o_t.astype(jnp.float32)
+            * w_t.transpose(0, 2, 1)[..., None]
+        )
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return (o_new, lse_new, lax.ppermute(k_t, axis_name, perm),
+                lax.ppermute(v_t, axis_name, perm))
+
+    o, _, _, _ = lax.fori_loop(0, n, body, (o0, lse0, k, v))
+    return o.astype(q.dtype)
+
+
 def make_ring_attention(mesh, axis_name: str = "seq", causal: bool = True,
-                        scale: Optional[float] = None):
+                        scale: Optional[float] = None,
+                        block_impl: str = "einsum",
+                        block_q: int = 128, block_k: int = 128):
     """Build a jittable attention fn over sequence-sharded q,k,v.
 
     Inputs/outputs are GLOBAL arrays [B, S, H, D] sharded on S over
     ``axis_name`` (use `jax.device_put` with PartitionSpec(None, axis_name,
     None, None)). Wraps the per-device ring in shard_map.
-    """
+
+    ``block_impl``: "einsum" (default) runs the local block math as XLA
+    einsums — differentiable, the training path. "flash" runs each local
+    block through the pallas flash kernel and merges (out, lse) streams —
+    the long-context inference/scoring fast path (MXU-tiled blocks,
+    future kv blocks skipped at block granularity; no VJP)."""
     import jax
     from jax.sharding import PartitionSpec as P
     try:
@@ -122,12 +193,26 @@ def make_ring_attention(mesh, axis_name: str = "seq", causal: bool = True,
         check_kwargs = {"check_rep": False}
 
     spec = P(None, axis_name, None, None)
-    fn = functools.partial(
-        _ring_attention_sharded,
-        axis_name=axis_name,
-        causal=causal,
-        scale=scale,
-    )
+    if block_impl == "flash":
+        fn = functools.partial(
+            _ring_attention_sharded_flash,
+            axis_name=axis_name,
+            causal=causal,
+            scale=scale,
+            block_q=block_q,
+            block_k=block_k,
+        )
+    elif block_impl == "einsum":
+        fn = functools.partial(
+            _ring_attention_sharded,
+            axis_name=axis_name,
+            causal=causal,
+            scale=scale,
+        )
+    else:
+        raise ValueError(
+            f"unknown block_impl {block_impl!r}; have 'einsum', 'flash'"
+        )
     return shard_map(
         fn,
         mesh=mesh,
@@ -138,6 +223,11 @@ def make_ring_attention(mesh, axis_name: str = "seq", causal: bool = True,
 
 
 def ring_attention(q, k, v, mesh, axis_name: str = "seq",
-                   causal: bool = True, scale: Optional[float] = None):
+                   causal: bool = True, scale: Optional[float] = None,
+                   block_impl: str = "einsum",
+                   block_q: int = 128, block_k: int = 128):
     """One-shot convenience wrapper around make_ring_attention."""
-    return make_ring_attention(mesh, axis_name, causal, scale)(q, k, v)
+    return make_ring_attention(
+        mesh, axis_name, causal, scale,
+        block_impl=block_impl, block_q=block_q, block_k=block_k,
+    )(q, k, v)
