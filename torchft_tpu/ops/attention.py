@@ -35,22 +35,77 @@ def reference_attention(q, k, v, causal: bool = True,
     return out
 
 
+_PALLAS_OK: Optional[bool] = None
+
+
+def _pallas_lowers() -> bool:
+    """One-time eager probe: compile+run the flash kernel fwd AND bwd on a
+    tiny shape. A try/except around the flash_attention *call* cannot
+    catch Mosaic lowering errors — pallas blockspec validation fires when
+    the enclosing jit compiles, long after dispatch returned — so the
+    probe compiles eagerly (concrete inputs stay independent of any
+    ambient trace) and caches the verdict for the process."""
+    global _PALLAS_OK
+    if _PALLAS_OK is None:
+        try:
+            from torchft_tpu.ops import flash as _flash_mod
+            from torchft_tpu.ops.flash import flash_attention
+
+            key = jax.random.key(0)
+            x = jax.random.normal(key, (1, 256, 1, 64), jnp.bfloat16)
+
+            def probe_loss(q):
+                return jnp.sum(
+                    flash_attention(q, q, q, causal=True)
+                    .astype(jnp.float32)
+                )
+
+            # resident-KV regime
+            jax.device_get(jax.jit(jax.grad(probe_loss))(x))
+            # streamed regime: force it on the same tiny shape (the
+            # kernels and blockspecs differ; a resident-only probe would
+            # let streamed lowering failures crash long-context jits)
+            saved = _flash_mod._RESIDENT_KV_BYTES
+            _flash_mod._RESIDENT_KV_BYTES = 0
+            try:
+                jax.device_get(jax.jit(jax.grad(probe_loss))(x))
+            finally:
+                _flash_mod._RESIDENT_KV_BYTES = saved
+            _PALLAS_OK = True
+        except Exception as e:  # noqa: BLE001 — any lowering/runtime failure
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "pallas flash kernel unavailable on this backend "
+                "(falling back to XLA attention): %s", e
+            )
+            _PALLAS_OK = False
+    return _PALLAS_OK
+
+
 def _use_pallas() -> bool:
     if os.environ.get("TORCHFT_TPU_DISABLE_PALLAS"):
         return False
     try:
-        return jax.default_backend() not in ("cpu",)
+        if jax.default_backend() in ("cpu",):
+            return False
     except Exception:  # pragma: no cover
         return False
+    return _pallas_lowers()
 
 
 def causal_attention(q, k, v, scale: Optional[float] = None):
-    """Dispatch: pallas flash kernel on TPU, reference path elsewhere."""
-    if _use_pallas():
-        try:
-            from torchft_tpu.ops.flash import flash_attention
+    """Dispatch: pallas flash kernel on TPU, reference path elsewhere.
 
+    The try/except catches trace-time rejections (e.g. a sequence length
+    that isn't a multiple of the block size); compile-time Mosaic
+    rejections can't surface here, which is what the one-time lowering
+    probe in _pallas_lowers covers."""
+    if _use_pallas():
+        from torchft_tpu.ops.flash import flash_attention
+
+        try:
             return flash_attention(q, k, v, causal=True, scale=scale)
-        except Exception:  # pragma: no cover — kernel unavailable: fall back
+        except ValueError:  # shape unsupported by the kernel: fall back
             pass
     return reference_attention(q, k, v, causal=True, scale=scale)

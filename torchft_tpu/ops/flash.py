@@ -15,6 +15,14 @@ regimes are fused: resident kernels hold K/V (resp. Q/dO) in VMEM for
 short/medium sequences; streamed kernels ride tiles over the innermost
 grid dimension with VMEM scratch accumulators for long context.
 
+Mosaic layout note: per-row statistics (lse, delta) ride through HBM as
+[BH, S, 1] so every block spec keeps its last two dims tile-legal
+(second-to-last divisible by 8, last equal to the array dim); inside the
+kernels they stay 2-D [BQ, 1] column vectors — Mosaic's tiled layout
+prefers 2-D keepdims math over 1-D vectors. (jax's reference TPU kernel
+broadcasts lse across 128 lanes instead; the singleton lane column costs
+128x less HBM traffic and lowers fine.)
+
 Use interpret=True (or TORCHFT_TPU_PALLAS_INTERPRET=1) to run the same
 kernel on CPU for tests.
 """
@@ -54,8 +62,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         upper = num_k_blocks
 
     acc0 = jnp.zeros((block_q, d), dtype=jnp.float32)
-    m0 = jnp.full((block_q,), _NEG_INF, dtype=jnp.float32)
-    l0 = jnp.zeros((block_q,), dtype=jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((block_q, 1), dtype=jnp.float32)
 
     def body(ki, carry):
         acc, m, l = carry
@@ -73,11 +81,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -85,7 +93,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
 
     acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
     l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(l)
 
 
@@ -144,7 +152,7 @@ def _flash_streamed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
         l = l_ref[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:, :1] + jnp.log(l))[:, 0]
+        lse_ref[0] = m_ref[:, :1] + jnp.log(l)
 
 
 # KV footprint above which the k-streamed kernel is used (resident variant
@@ -157,9 +165,10 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
     """q,k,v: [BH, S, D] -> (out [BH, S, D], lse [BH, S] f32)."""
     bh, seq_len, d = q.shape
     kv_bytes = 2 * seq_len * d * q.dtype.itemsize
+    # lse travels as [BH, S, 1] (see module docstring: tile-legal specs)
     out_shapes = (
         jax.ShapeDtypeStruct(q.shape, q.dtype),
-        jax.ShapeDtypeStruct((bh, seq_len), jnp.float32),
+        jax.ShapeDtypeStruct((bh, seq_len, 1), jnp.float32),
     )
     if kv_bytes <= _RESIDENT_KV_BYTES:
         grid = (bh, seq_len // block_q)
@@ -171,7 +180,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
             causal=causal,
             scale=scale,
         )
-        return pl.pallas_call(
+        out, lse = pl.pallas_call(
             kernel,
             grid=grid,
             in_specs=[
@@ -181,11 +190,12 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
             ],
             out_shape=out_shapes,
             interpret=interpret,
         )(q, k, v)
+        return out, lse[..., 0]
 
     # Long context: stream K/V tiles via the grid.
     num_k_blocks = seq_len // block_k
@@ -203,7 +213,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
         pltpu.VMEM((block_q, 128), jnp.float32),
         pltpu.VMEM((block_q, 128), jnp.float32),
     ]
-    return pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -213,12 +223,13 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=out_shapes,
         scratch_shapes=scratch,
         interpret=interpret,
     )(q, k, v)
+    return out, lse[..., 0]
 
 
 # ------------------------------------------------------------- backward pass
@@ -233,7 +244,8 @@ def _bwd_p_ds(q_scaled, k, v, do, lse, delta, qi, ki, block_q: int,
               block_k: int, causal: bool):
     """Shared score recompute for every backward kernel: P = exp(S − lse)
     with the causal mask, and dS = P ⊙ (dO·Vᵀ − Δ). One definition so
-    mask/softmax changes can never diverge between regimes."""
+    mask/softmax changes can never diverge between regimes. lse and delta
+    are [BQ, 1] column vectors (2-D keepdims math lowers best on Mosaic)."""
     s = jax.lax.dot_general(
         q_scaled, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -246,12 +258,12 @@ def _bwd_p_ds(q_scaled, k, v, do, lse, delta, qi, ki, block_q: int,
             jnp.int32, (block_q, block_k), 1
         )
         s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-    p = jnp.exp(s - lse[:, None])
+    p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    ds = p * (dp - delta[:, None])
+    ds = p * (dp - delta)
     return p, ds
 
 
@@ -261,8 +273,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale      # [BQ, D]
     do = do_ref[0].astype(jnp.float32)            # [BQ, D]
-    lse = lse_ref[0]                              # [BQ]
-    delta = delta_ref[0]                          # [BQ]
+    lse = lse_ref[0]                              # [BQ, 1]
+    delta = delta_ref[0]                          # [BQ, 1]
     d = q.shape[-1]
 
     num_k_blocks = seq_len // block_k
@@ -309,8 +321,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             jnp.float32
         ) * scale
         do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q)]
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :]    # [BQ, 1]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), :]
         p, ds = _bwd_p_ds(
             q, k, v, do, lse, delta, qi, ki, block_q, block_k, causal
         )
@@ -423,6 +435,8 @@ def _flash_backward_streamed(q, k, v, g, lse, delta, causal: bool,
     bh, seq_len, d = q.shape
     num_q_blocks = seq_len // block_q
     num_k_blocks = seq_len // block_k
+    lse = lse[..., None]      # [BH, S, 1] — tile-legal spec layout
+    delta = delta[..., None]
 
     dq = pl.pallas_call(
         functools.partial(
@@ -436,8 +450,8 @@ def _flash_backward_streamed(q, k, v, g, lse, delta, causal: bool,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -457,8 +471,8 @@ def _flash_backward_streamed(q, k, v, g, lse, delta, causal: bool,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
@@ -491,6 +505,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, scale: float,
             q, k, v, g, lse, delta, causal, scale, block_q, block_k,
             interpret,
         )
+    lse = lse[..., None]      # [BH, S, 1] — tile-legal spec layout
+    delta = delta[..., None]
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, block_q=block_q, block_k=block_k,
@@ -504,8 +520,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, scale: float,
             pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -524,8 +540,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, scale: float,
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, seq_len, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, seq_len), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, seq_len), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, seq_len, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, seq_len, 1), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
