@@ -48,29 +48,28 @@ def _pallas_lowers() -> bool:
     global _PALLAS_OK
     if _PALLAS_OK is None:
         try:
-            from torchft_tpu.ops import flash as _flash_mod
             from torchft_tpu.ops.flash import flash_attention
 
             key = jax.random.key(0)
             x = jax.random.normal(key, (1, 256, 1, 64), jnp.bfloat16)
 
-            def probe_loss(q):
-                return jnp.sum(
-                    flash_attention(q, q, q, causal=True)
-                    .astype(jnp.float32)
-                )
+            def probe_loss(threshold):
+                def loss(q):
+                    return jnp.sum(
+                        flash_attention(
+                            q, q, q, causal=True,
+                            _resident_kv_bytes=threshold,
+                        ).astype(jnp.float32)
+                    )
+                return loss
 
-            # resident-KV regime
-            jax.device_get(jax.jit(jax.grad(probe_loss))(x))
-            # streamed regime: force it on the same tiny shape (the
-            # kernels and blockspecs differ; a resident-only probe would
-            # let streamed lowering failures crash long-context jits)
-            saved = _flash_mod._RESIDENT_KV_BYTES
-            _flash_mod._RESIDENT_KV_BYTES = 0
-            try:
-                jax.device_get(jax.jit(jax.grad(probe_loss))(x))
-            finally:
-                _flash_mod._RESIDENT_KV_BYTES = saved
+            # resident-KV regime (tiny shape, default threshold)
+            jax.device_get(jax.jit(jax.grad(probe_loss(None)))(x))
+            # streamed regime, forced per-call on the same tiny shape
+            # (the kernels and blockspecs differ; a resident-only probe
+            # would let streamed lowering failures crash long-context
+            # jits)
+            jax.device_get(jax.jit(jax.grad(probe_loss(0)))(x))
             _PALLAS_OK = True
         except Exception as e:  # noqa: BLE001 — any lowering/runtime failure
             import logging

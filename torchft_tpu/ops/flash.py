@@ -42,7 +42,11 @@ try:  # TPU-specific bits are unavailable when lowering for CPU interpret
 except ImportError:  # pragma: no cover
     pltpu = None
 
-__all__ = ["flash_attention", "flash_attention_with_lse"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_with_lse",
+    "flash_block_attention_bwd",
+]
 
 _NEG_INF = -1e30  # avoid nan from (-inf) - (-inf) in the running max
 
@@ -161,16 +165,19 @@ _RESIDENT_KV_BYTES = 2 * 1024 * 1024
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
-                   block_k: int, interpret: bool):
+                   block_k: int, interpret: bool,
+                   resident_kv_bytes: Optional[int] = None):
     """q,k,v: [BH, S, D] -> (out [BH, S, D], lse [BH, S] f32)."""
     bh, seq_len, d = q.shape
+    threshold = (_RESIDENT_KV_BYTES if resident_kv_bytes is None
+                 else resident_kv_bytes)
     kv_bytes = 2 * seq_len * d * q.dtype.itemsize
     # lse travels as [BH, S, 1] (see module docstring: tile-legal specs)
     out_shapes = (
         jax.ShapeDtypeStruct(q.shape, q.dtype),
         jax.ShapeDtypeStruct((bh, seq_len, 1), jnp.float32),
     )
-    if kv_bytes <= _RESIDENT_KV_BYTES:
+    if kv_bytes <= threshold:
         grid = (bh, seq_len // block_q)
         kernel = functools.partial(
             _flash_kernel,
@@ -492,15 +499,33 @@ def _flash_backward_streamed(q, k, v, g, lse, delta, causal: bool,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal: bool, scale: float,
-                    block_q: int, block_k: int, interpret: bool):
-    """Fused pallas backward: resident variant (full K/V resp. Q/dO in
-    VMEM) below the threshold, streamed tiles above it."""
-    bh, seq_len, d = q.shape
+                    block_q: int, block_k: int, interpret: bool,
+                    resident_kv_bytes: Optional[int] = None):
+    """Fused pallas backward: delta from (out, g), then the kernel core."""
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )  # [BH, S]
+    return _flash_backward_core(
+        q, k, v, g, lse, delta, causal, scale, block_q, block_k,
+        interpret, resident_kv_bytes,
+    )
+
+
+def _flash_backward_core(q, k, v, g, lse, delta, causal: bool,
+                         scale: float, block_q: int, block_k: int,
+                         interpret: bool,
+                         resident_kv_bytes: Optional[int] = None):
+    """Kernel core with EXTERNAL lse/delta ([BH, S] f32): resident variant
+    (full K/V resp. Q/dO in VMEM) below the threshold, streamed tiles
+    above it. External statistics are what make the ring backward work —
+    with the GLOBAL lse and delta, each (q-block, kv-block) pair's
+    dq/dk/dv contributions are independent (FlashAttention-2), so pairs
+    can be revisited in any order/placement and summed."""
+    bh, seq_len, d = q.shape
+    threshold = (_RESIDENT_KV_BYTES if resident_kv_bytes is None
+                 else resident_kv_bytes)
     kv_bytes = 2 * seq_len * d * q.dtype.itemsize
-    if kv_bytes > _RESIDENT_KV_BYTES:
+    if kv_bytes > threshold:
         return _flash_backward_streamed(
             q, k, v, g, lse, delta, causal, scale, block_q, block_k,
             interpret,
@@ -567,25 +592,31 @@ def _reference(q, k, v, causal: bool, scale: float):
     return out[:, :, 0].astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
+           resident_kv_bytes):
     out, _ = _flash_forward(
-        q, k, v, causal, scale, block_q, block_k, interpret
+        q, k, v, causal, scale, block_q, block_k, interpret,
+        resident_kv_bytes,
     )
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               resident_kv_bytes):
     out, lse = _flash_forward(
-        q, k, v, causal, scale, block_q, block_k, interpret
+        q, k, v, causal, scale, block_q, block_k, interpret,
+        resident_kv_bytes,
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret,
+               resident_kv_bytes, residuals, g):
     q, k, v, out, lse = residuals
     return _flash_backward(
-        q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret
+        q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret,
+        resident_kv_bytes,
     )
 
 
@@ -629,8 +660,10 @@ def flash_attention_with_lse(q, k, v, causal: bool = True,
     ``lse' = logaddexp(lse_a, lse_b); out' = sum_i out_i * exp(lse_i -
     lse')`` — the blockwise/ring/flash-decoding composition rule
     (parallel/ring.py uses it for the flash-block ring path). No custom
-    VJP is defined for this surface; use ``flash_attention`` (or the
-    einsum ring path) where gradients are needed."""
+    VJP is defined on THIS surface; for gradients use
+    ``flash_attention``, or the ring paths in parallel/ring.py — the
+    flash ring differentiates via its own ring-structured VJP built on
+    ``flash_block_attention_bwd``."""
     b, s, h, _ = q.shape
     scale, block_q, block_k, interpret, merge, unmerge = _bshd_prologue(
         q, scale, block_q, block_k, interpret
@@ -642,18 +675,57 @@ def flash_attention_with_lse(q, k, v, causal: bool = True,
     return unmerge(out), lse.reshape(b, h, s)
 
 
+def flash_block_attention_bwd(q, k, v, do, lse, delta, causal: bool,
+                              scale: Optional[float] = None,
+                              block_q: int = 128, block_k: int = 128,
+                              interpret: Optional[bool] = None):
+    """Gradient CONTRIBUTIONS of one (q-block, kv-block) pair under
+    global softmax statistics.
+
+    q, k, v, do: [B, S, H, D] (q and k blocks the same length);
+    lse, delta: [B, H, S] f32 — the GLOBAL log-sum-exp of q's full
+    (cross-block) attention row and the global delta = rowsum(dO ⊙ O).
+    Returns (dq, dk, dv) for this pair only; summing over every pair a
+    q row attends to yields the exact full gradients (FlashAttention-2
+    decomposition — P = exp(S − lse) is already globally normalized, so
+    pair contributions are independent). This is the building block of
+    the ring-attention backward (parallel/ring.py): the diagonal pair
+    runs causal=True, past pairs causal=False."""
+    b, s, h, _ = q.shape
+    scale, block_q, block_k, interpret, merge, unmerge = _bshd_prologue(
+        q, scale, block_q, block_k, interpret
+    )
+
+    def merge_stat(x):  # [B,H,S] -> [BH, S]
+        return x.reshape(b * h, s)
+
+    dq, dk, dv = _flash_backward_core(
+        merge(q), merge(k), merge(v), merge(do),
+        merge_stat(lse.astype(jnp.float32)),
+        merge_stat(delta.astype(jnp.float32)),
+        causal, scale, block_q, block_k, interpret,
+    )
+    return unmerge(dq), unmerge(dk), unmerge(dv)
+
+
 def flash_attention(q, k, v, causal: bool = True,
                     scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    _resident_kv_bytes: Optional[int] = None):
     """[B, S, H, D] flash attention (pallas on TPU).
 
     Sequence length must be a multiple of the block sizes (pad upstream if
     needed; the model configs here use powers of two).
+
+    ``_resident_kv_bytes`` overrides the resident-vs-streamed regime
+    threshold for THIS call (0 forces the streamed kernels); used by the
+    dispatch probe in ops/attention.py to lowering-check both regimes on
+    a tiny shape without touching shared state.
     """
     scale, block_q, block_k, interpret, merge, unmerge = _bshd_prologue(
         q, scale, block_q, block_k, interpret
     )
     out = _flash(merge(q), merge(k), merge(v), causal, scale,
-                 block_q, block_k, interpret)
+                 block_q, block_k, interpret, _resident_kv_bytes)
     return unmerge(out)

@@ -20,6 +20,8 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
+import jax
+
 __all__ = ["ring_attention", "make_ring_attention"]
 
 
@@ -102,18 +104,18 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
     return out.astype(q.dtype)
 
 
-def _ring_attention_sharded_flash(q, k, v, axis_name: str, causal: bool,
-                                  scale: Optional[float], block_q: int,
-                                  block_k: int):
+def _ring_flash_fwd_impl(q, k, v, axis_name: str, causal: bool,
+                         scale: Optional[float], block_q: int,
+                         block_k: int):
     """Flash-block ring body: each (q-block, kv-block) pair runs the
     pallas flash kernel (ops/flash.py) instead of the einsum online
     softmax, and the per-pair (out, lse) results merge exactly via the
     logaddexp rule. Causality is handled at BLOCK granularity: a kv block
     strictly in the future is skipped outright (lax.cond — no wasted MXU
     work, the n/2 saving dense ring masking forfeits), the diagonal block
-    runs the causal kernel, past blocks run unmasked. Forward-optimized:
-    flash_attention_with_lse defines no VJP, so use the einsum path
-    (block_impl="einsum") for training."""
+    runs the causal kernel, past blocks run unmasked. Returns
+    (out [B,Sq,H,D], global lse [B,H,Sq]) — lse is the residual the
+    ring backward needs."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -162,8 +164,101 @@ def _ring_attention_sharded_flash(q, k, v, axis_name: str, causal: bool,
         return (o_new, lse_new, lax.ppermute(k_t, axis_name, perm),
                 lax.ppermute(v_t, axis_name, perm))
 
-    o, _, _, _ = lax.fori_loop(0, n, body, (o0, lse0, k, v))
-    return o.astype(q.dtype)
+    o, lse, _, _ = lax.fori_loop(0, n, body, (o0, lse0, k, v))
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_attention_sharded_flash(q, k, v, axis_name, causal, scale,
+                                  block_q, block_k):
+    out, _ = _ring_flash_fwd_impl(
+        q, k, v, axis_name, causal, scale, block_q, block_k
+    )
+    return out
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale, block_q,
+                        block_k):
+    out, lse = _ring_flash_fwd_impl(
+        q, k, v, axis_name, causal, scale, block_q, block_k
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k,
+                        residuals, g):
+    """Ring-structured FlashAttention-2 backward. With the GLOBAL lse and
+    delta = rowsum(dO ⊙ O) — both q-sharded, both local — every
+    (q-block, kv-block) pair's dq/dk/dv contributions are independent, so
+    the backward rides the SAME ring schedule as the forward: kv blocks
+    rotate together with their dk/dv accumulators, each device adds its
+    pair's contribution as the block passes through, and after n hops
+    every accumulator is home. dq accumulates locally. Future pairs are
+    skipped at block granularity (lax.cond), the diagonal pair runs the
+    causal kernels, past pairs the unmasked ones — the same n/2 compute
+    saving as the forward."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from torchft_tpu.ops.flash import flash_block_attention_bwd
+
+    q, k, v, out, lse = residuals
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    eff_scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    # delta is local: out and its cotangent are q-sharded. [B, H, Sq]
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)
+
+    dq0 = jnp.zeros(q.shape, dtype=jnp.float32)
+    dkv0 = jnp.zeros(k.shape, dtype=jnp.float32)
+
+    def body(t, carry):
+        dq_acc, k_t, v_t, dk_t, dv_t = carry
+        src = (idx - t) % n
+
+        def pair_bwd(causal_flag: bool):
+            return lambda: flash_block_attention_bwd(
+                q, k_t, v_t, g, lse, delta, causal=causal_flag,
+                scale=eff_scale, block_q=block_q, block_k=block_k,
+            )
+
+        if causal:
+            dq_t, dk_p, dv_p = lax.cond(
+                src > idx,
+                lambda: (jnp.zeros(q.shape, q.dtype),
+                         jnp.zeros(k.shape, k.dtype),
+                         jnp.zeros(v.shape, v.dtype)),
+                lambda: lax.cond(
+                    src == idx, pair_bwd(True), pair_bwd(False)
+                ),
+            )
+        else:
+            dq_t, dk_p, dv_p = pair_bwd(False)()
+
+        dq_acc = dq_acc + dq_t.astype(jnp.float32)
+        dk_t = dk_t + dk_p.astype(jnp.float32)
+        dv_t = dv_t + dv_p.astype(jnp.float32)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return (
+            dq_acc,
+            lax.ppermute(k_t, axis_name, perm),
+            lax.ppermute(v_t, axis_name, perm),
+            lax.ppermute(dk_t, axis_name, perm),
+            lax.ppermute(dv_t, axis_name, perm),
+        )
+
+    dq, _, _, dk, dv = lax.fori_loop(
+        0, n, body, (dq0, k, v, dkv0, dkv0)
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_attention_sharded_flash.defvjp(_ring_flash_vjp_fwd,
+                                     _ring_flash_vjp_bwd)
 
 
 def make_ring_attention(mesh, axis_name: str = "seq", causal: bool = True,
@@ -177,10 +272,12 @@ def make_ring_attention(mesh, axis_name: str = "seq", causal: bool = True,
     None, None)). Wraps the per-device ring in shard_map.
 
     ``block_impl``: "einsum" (default) runs the local block math as XLA
-    einsums — differentiable, the training path. "flash" runs each local
-    block through the pallas flash kernel and merges (out, lse) streams —
-    the long-context inference/scoring fast path (MXU-tiled blocks,
-    future kv blocks skipped at block granularity; no VJP)."""
+    einsums. "flash" runs each local block through the pallas flash
+    kernel and merges (out, lse) streams (MXU-tiled blocks, future kv
+    blocks skipped at block granularity). Both are differentiable: the
+    flash path carries a ring-structured FlashAttention-2 custom VJP
+    (kv blocks and their dk/dv accumulators rotate together; see
+    _ring_flash_vjp_bwd)."""
     import jax
     from jax.sharding import PartitionSpec as P
     try:
@@ -194,14 +291,12 @@ def make_ring_attention(mesh, axis_name: str = "seq", causal: bool = True,
 
     spec = P(None, axis_name, None, None)
     if block_impl == "flash":
-        fn = functools.partial(
-            _ring_attention_sharded_flash,
-            axis_name=axis_name,
-            causal=causal,
-            scale=scale,
-            block_q=block_q,
-            block_k=block_k,
-        )
+        # positional binding: custom_vjp with nondiff_argnums rejects
+        # keyword arguments
+        def fn(q, k, v):
+            return _ring_attention_sharded_flash(
+                q, k, v, axis_name, causal, scale, block_q, block_k
+            )
     elif block_impl == "einsum":
         fn = functools.partial(
             _ring_attention_sharded,
