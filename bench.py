@@ -176,7 +176,14 @@ def _flops_per_step(cfg, n_params: int, seq_len: int,
                     tokens_per_step: int) -> float:
     """Analytic training FLOPs per step: 6*N per token (fwd+bwd matmuls)
     plus the causal attention term 6*L*d_model*S per token (half of the
-    non-causal 12*L*d*S)."""
+    non-causal 12*L*d*S).
+
+    Deliberately counts MODEL FLOPs only (the standard MFU convention):
+    recompute the step chooses to do — jax.checkpoint remat of blocks,
+    the chunked-xent lm-head re-matmul in backward (ops/xent.py) — is
+    extra hardware work, not useful model work, so it is NOT credited.
+    MFU therefore dips slightly when a recompute trade is enabled even at
+    identical hardware efficiency; tokens/sec is the end-to-end truth."""
     per_token = 6.0 * n_params + 6.0 * cfg.n_layers * cfg.d_model * seq_len
     return per_token * tokens_per_step
 

@@ -1,0 +1,136 @@
+"""Chunked (online-logsumexp) cross entropy vs the dense reference.
+
+The chunked path must be numerically interchangeable with dense
+log_softmax — both in value and in (dx, dw) gradients — because the
+flagship configs use it for every training loss (models/transformer.py
+cites ops/xent.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchft_tpu.ops.xent import chunked_cross_entropy
+
+
+def _dense_ce(x, w, targets):
+    logits = x.astype(jnp.float32) @ w.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(
+        jnp.take_along_axis(logp, targets[:, None], axis=-1)[:, 0]
+    )
+
+
+@pytest.mark.parametrize("n,d,v,chunks", [
+    (64, 16, 128, 8),
+    (33, 8, 96, 4),     # n not a multiple of anything interesting
+    (16, 32, 64, 1),    # single chunk == dense
+])
+def test_chunked_ce_value(n, d, v, chunks) -> None:
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((d, v)) * 0.5, jnp.float32)
+    t = jnp.asarray(rng.integers(0, v, (n,)), jnp.int32)
+    got = chunked_cross_entropy(x, w, t, chunks)
+    want = _dense_ce(x, w, t)
+    np.testing.assert_allclose(
+        float(got), float(want), atol=1e-6, rtol=1e-6
+    )
+
+
+@pytest.mark.parametrize("chunks", [2, 8])
+def test_chunked_ce_grads(chunks) -> None:
+    n, d, v = 48, 12, 64
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((d, v)) * 0.5, jnp.float32)
+    t = jnp.asarray(rng.integers(0, v, (n,)), jnp.int32)
+
+    gx, gw = jax.grad(
+        lambda x, w: chunked_cross_entropy(x, w, t, chunks),
+        argnums=(0, 1),
+    )(x, w)
+    rx, rw = jax.grad(
+        lambda x, w: _dense_ce(x, w, t), argnums=(0, 1)
+    )(x, w)
+    np.testing.assert_allclose(
+        np.asarray(gx), np.asarray(rx), atol=1e-6, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(gw), np.asarray(rw), atol=1e-6, rtol=1e-5
+    )
+
+
+def test_chunked_ce_jit_and_extreme_logits() -> None:
+    # online logsumexp must stay finite where naive exp overflows
+    n, d, v = 8, 4, 32
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((n, d)) * 100.0, jnp.float32)
+    w = jnp.asarray(rng.standard_normal((d, v)) * 100.0, jnp.float32)
+    t = jnp.asarray(rng.integers(0, v, (n,)), jnp.int32)
+    got = jax.jit(
+        lambda x, w, t: chunked_cross_entropy(x, w, t, 4)
+    )(x, w, t)
+    want = _dense_ce(x, w, t)
+    assert np.isfinite(float(got))
+    np.testing.assert_allclose(
+        float(got), float(want), atol=1e-4, rtol=1e-5
+    )
+
+
+def test_model_loss_chunked_matches_dense() -> None:
+    # the model-level switch: same config with/without xent_chunks must
+    # produce the same loss and grads
+    import dataclasses
+
+    from torchft_tpu.models import CONFIGS, init_params, loss_fn
+
+    cfg_dense = CONFIGS["tiny"]
+    assert cfg_dense.xent_chunks == 0
+    cfg_chunked = dataclasses.replace(cfg_dense, xent_chunks=4)
+    params = init_params(cfg_dense, jax.random.key(0))
+    rng = np.random.default_rng(3)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg_dense.vocab_size, (2, 64)), jnp.int32
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    l_dense, g_dense = jax.value_and_grad(
+        lambda p: loss_fn(cfg_dense, p, tokens, targets)
+    )(params)
+    l_chunk, g_chunk = jax.value_and_grad(
+        lambda p: loss_fn(cfg_chunked, p, tokens, targets)
+    )(params)
+    np.testing.assert_allclose(
+        float(l_dense), float(l_chunk), atol=1e-5, rtol=1e-5
+    )
+    flat_d, _ = jax.tree_util.tree_flatten(g_dense)
+    flat_c, _ = jax.tree_util.tree_flatten(g_chunk)
+    for a, b in zip(flat_d, flat_c):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-3
+        )
+
+
+def test_llama_loss_chunked_matches_dense() -> None:
+    import dataclasses
+
+    from torchft_tpu.models.llama import (
+        LlamaConfig, llama_init_params, llama_loss_fn,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=64, remat=False,
+    )
+    cfg_c = dataclasses.replace(cfg, xent_chunks=4)
+    params = llama_init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(4)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 32)),
+                         jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    l_d = float(llama_loss_fn(cfg, params, tokens, targets))
+    l_c = float(llama_loss_fn(cfg_c, params, tokens, targets))
+    np.testing.assert_allclose(l_d, l_c, atol=1e-5, rtol=1e-5)

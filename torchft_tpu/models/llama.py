@@ -44,6 +44,9 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # >0: llama_loss_fn fuses the vocab projection via ops/xent.py's
+    # online-logsumexp scan (never materializes [B,S,V] logits); 0=dense
+    xent_chunks: int = 0
 
     def __post_init__(self) -> None:
         assert self.d_model % self.n_heads == 0, (
@@ -181,8 +184,9 @@ def _block(cfg: LlamaConfig, layer: Dict, x, *, attn_fn):
     return x
 
 
-def llama_forward(cfg: LlamaConfig, params, tokens,
-                  attn_fn: Optional[Callable] = None):
+def llama_forward_hidden(cfg: LlamaConfig, params, tokens,
+                         attn_fn: Optional[Callable] = None):
+    """tokens -> final-RMSNorm hidden states [B,S,d_model]."""
     if attn_fn is None:
         attn_fn = _default_attention
     dt = cfg.dtype
@@ -192,7 +196,12 @@ def llama_forward(cfg: LlamaConfig, params, tokens,
         block = jax.checkpoint(block)
     for layer in params["layers"]:
         x = block(layer, x)
-    x = _rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps)
+    return _rms_norm(x, params["final_norm"]["scale"], cfg.rms_eps)
+
+
+def llama_forward(cfg: LlamaConfig, params, tokens,
+                  attn_fn: Optional[Callable] = None):
+    x = llama_forward_hidden(cfg, params, tokens, attn_fn)
     # final projection in f32 (parity with transformer.py): logits feed
     # log_softmax, and bf16 rounding there would contaminate the loss
     return x.astype(jnp.float32) @ params["lm_head"]["kernel"].astype(
@@ -202,6 +211,13 @@ def llama_forward(cfg: LlamaConfig, params, tokens,
 
 def llama_loss_fn(cfg: LlamaConfig, params, tokens, targets,
                   attn_fn: Optional[Callable] = None):
+    if cfg.xent_chunks > 0:
+        from torchft_tpu.ops.xent import hidden_cross_entropy
+
+        h = llama_forward_hidden(cfg, params, tokens, attn_fn)
+        return hidden_cross_entropy(
+            h, params["lm_head"]["kernel"], targets, cfg.xent_chunks
+        )
     logits = llama_forward(cfg, params, tokens, attn_fn)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

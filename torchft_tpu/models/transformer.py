@@ -43,6 +43,11 @@ class TransformerConfig:
     remat: bool = True
     attention: str = "local"    # "local" | "ring"
     seq_axis: str = "seq"       # mesh axis for ring attention
+    # >0: loss_fn uses ops/xent.py's online-logsumexp scan over this many
+    # vocab chunks instead of materializing [B, S, V] logits (the logits
+    # tensor is the single largest HBM consumer at small-d_model/32k-vocab
+    # shapes). 0 = dense log_softmax.
+    xent_chunks: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -57,15 +62,15 @@ CONFIGS: Dict[str, TransformerConfig] = {
     ),
     "125m": TransformerConfig(
         vocab_size=32768, d_model=768, n_layers=12, n_heads=12, d_ff=3072,
-        max_seq_len=1024,
+        max_seq_len=1024, xent_chunks=8,
     ),
     "350m": TransformerConfig(
         vocab_size=32768, d_model=1024, n_layers=24, n_heads=16, d_ff=4096,
-        max_seq_len=1024,
+        max_seq_len=1024, xent_chunks=8,
     ),
     "1b": TransformerConfig(
         vocab_size=32768, d_model=2048, n_layers=24, n_heads=16, d_ff=8192,
-        max_seq_len=2048,
+        max_seq_len=2048, xent_chunks=8,
     ),
 }
 
@@ -154,13 +159,14 @@ def _block(cfg: TransformerConfig, layer: Dict, x, *, attn_fn):
     return x
 
 
-def forward(
+def forward_hidden(
     cfg: TransformerConfig,
     params: Dict,
     tokens,
     attn_fn: Optional[Callable] = None,
 ) -> Any:
-    """tokens [B,S] int32 -> logits [B,S,vocab] (f32)."""
+    """tokens [B,S] int32 -> final-norm hidden states [B,S,d_model]
+    (pre-lm-head), so losses can fuse the vocab projection."""
     if attn_fn is None:
         attn_fn = _local_causal_attention
     B, S = tokens.shape
@@ -174,7 +180,17 @@ def forward(
     for i in range(cfg.n_layers):
         x = block(params[f"layers_{i}"], x)
 
-    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    return _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+
+
+def forward(
+    cfg: TransformerConfig,
+    params: Dict,
+    tokens,
+    attn_fn: Optional[Callable] = None,
+) -> Any:
+    """tokens [B,S] int32 -> logits [B,S,vocab] (f32)."""
+    x = forward_hidden(cfg, params, tokens, attn_fn)
     logits = x.astype(jnp.float32) @ params["lm_head"]["kernel"].astype(
         jnp.float32
     )
@@ -183,7 +199,16 @@ def forward(
 
 def loss_fn(cfg: TransformerConfig, params, tokens, targets,
             attn_fn: Optional[Callable] = None):
-    """Mean next-token cross entropy."""
+    """Mean next-token cross entropy. With cfg.xent_chunks > 0 the
+    [B, S, V] logits tensor is never materialized (ops/xent.py online
+    logsumexp; exact up to fp reassociation)."""
+    if cfg.xent_chunks > 0:
+        from torchft_tpu.ops.xent import hidden_cross_entropy
+
+        h = forward_hidden(cfg, params, tokens, attn_fn)
+        return hidden_cross_entropy(
+            h, params["lm_head"]["kernel"], targets, cfg.xent_chunks
+        )
     logits = forward(cfg, params, tokens, attn_fn)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
