@@ -134,3 +134,56 @@ def test_llama_loss_chunked_matches_dense() -> None:
     l_d = float(llama_loss_fn(cfg, params, tokens, targets))
     l_c = float(llama_loss_fn(cfg_c, params, tokens, targets))
     np.testing.assert_allclose(l_d, l_c, atol=1e-5, rtol=1e-5)
+
+
+def test_vocab_parallel_ce_value_and_grads() -> None:
+    # Megatron-style vocab-parallel CE over a sharded lm head must match
+    # the dense single-device loss in value and (dh, dw) gradients
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from torchft_tpu.ops.xent import make_vocab_parallel_cross_entropy
+    from torchft_tpu.parallel import ft_mesh
+
+    mesh = ft_mesh({"tensor": 4}, devices=jax.devices()[:4])
+    n, d, v = 32, 16, 64
+    rng = np.random.default_rng(5)
+    h = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((d, v)) * 0.5, jnp.float32)
+    t = jnp.asarray(rng.integers(0, v, (n,)), jnp.int32)
+    ws = jax.device_put(w, NamedSharding(mesh, P(None, "tensor")))
+
+    loss = make_vocab_parallel_cross_entropy(mesh, "tensor", num_chunks=2)
+    got = jax.jit(loss)(h, ws, t)
+    want = _dense_ce(h, w, t)
+    np.testing.assert_allclose(float(got), float(want), atol=1e-6,
+                               rtol=1e-6)
+
+    gh, gw = jax.jit(jax.grad(loss, argnums=(0, 1)))(h, ws, t)
+    rh, rw = jax.grad(
+        lambda h, w: _dense_ce(h, w, t), argnums=(0, 1)
+    )(h, w)
+    np.testing.assert_allclose(np.asarray(gh), np.asarray(rh),
+                               atol=1e-6, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                               atol=1e-6, rtol=1e-5)
+
+
+def test_vocab_parallel_ce_gradient_sharding_preserved() -> None:
+    # dw must come back vocab-sharded (no hidden all-gather of the head)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from torchft_tpu.ops.xent import make_vocab_parallel_cross_entropy
+    from torchft_tpu.parallel import ft_mesh
+
+    mesh = ft_mesh({"tensor": 4}, devices=jax.devices()[:4])
+    n, d, v = 16, 8, 32
+    rng = np.random.default_rng(6)
+    h = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+    w = jax.device_put(
+        jnp.asarray(rng.standard_normal((d, v)) * 0.5, jnp.float32),
+        NamedSharding(mesh, P(None, "tensor")),
+    )
+    t = jnp.asarray(rng.integers(0, v, (n,)), jnp.int32)
+    loss = make_vocab_parallel_cross_entropy(mesh, "tensor")
+    gw = jax.jit(jax.grad(loss, argnums=1))(h, w, t)
+    assert gw.sharding.spec == P(None, "tensor")

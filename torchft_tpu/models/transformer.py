@@ -201,7 +201,10 @@ def loss_fn(cfg: TransformerConfig, params, tokens, targets,
             attn_fn: Optional[Callable] = None):
     """Mean next-token cross entropy. With cfg.xent_chunks > 0 the
     [B, S, V] logits tensor is never materialized (ops/xent.py online
-    logsumexp; exact up to fp reassociation)."""
+    logsumexp; exact up to fp reassociation). The chunked path assumes a
+    replicated lm head — under tensor parallelism (vocab-sharded head,
+    tp_rules_gpt) use ops/xent.py's make_vocab_parallel_cross_entropy as
+    the loss instead (see __graft_entry__.dryrun_multichip §1b)."""
     if cfg.xent_chunks > 0:
         from torchft_tpu.ops.xent import hidden_cross_entropy
 

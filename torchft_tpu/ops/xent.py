@@ -6,22 +6,36 @@ tensor dwarfs everything else the train step touches: [B, S, V] f32 at the
 (the reference has no analog — its torch models never fuse this; XLA can't
 either, because log_softmax needs the full row before the gather).
 
-``chunked_cross_entropy`` never materializes [N, V]: a lax.scan over vocab
-chunks runs the classic online-softmax recurrence on [N, V/C] tiles —
-running row max m, running sumexp s rescaled by exp(m_old - m_new), plus
-the target logit gathered from whichever chunk holds it. The custom VJP
-re-runs the same scan, rebuilding each chunk's probabilities P_c =
-exp(logits_c - lse) on the fly and accumulating
+The core primitive ``chunked_lse_and_target`` never materializes [N, V]:
+a lax.scan over vocab chunks runs the classic online-softmax recurrence
+on [N, V/C] tiles — running row max m, running sumexp s rescaled by
+exp(m_old - m_new), plus the target logit gathered from whichever chunk
+holds it. Its custom VJP re-runs the same scan, rebuilding each chunk's
+logits on the fly and accumulating
 
-    dx    = sum_c (P_c - 1[t in c]) @ w_c^T     [N, D]
-    dw_c  = x^T @ (P_c - 1[t in c])             [D, V/C] per chunk
+    dlogits_c = exp(logits_c - lse) * g_lse + onehot_c * g_tl
+    dx       += dlogits_c @ w_c^T               [N, D]
+    dw_c      = dlogits_c^T @ x                 [V/C, D] per chunk
 
 so backward peak memory matches forward (one [N, V/C] tile live at a
 time) at the cost of recomputing the chunk matmuls — the same
-FLOPs-for-HBM trade as flash attention, applied to the lm head.
+FLOPs-for-HBM trade as flash attention, applied to the lm head. Because
+the VJP is written for GENERIC cotangents (g_lse, g_tl), the primitive
+composes under further transformations — in particular the
+vocab-parallel loss below differentiates through psum/logaddexp on top
+of it.
+
+``make_vocab_parallel_cross_entropy`` is the TP-native loss for a
+column-parallel (vocab-sharded) lm head: each device computes its
+shard's (lse, target-logit) pair locally via the chunked scan, then the
+shards combine with a pmax-stabilized logaddexp psum — Megatron's
+vocab-parallel cross entropy, done the TPU way (shard_map + XLA
+collectives, no gathered logits anywhere).
 
 Numerics match the dense log_softmax path up to fp reassociation of the
-sumexp (tests pin this to ~1e-6 in f32).
+sumexp (tests pin this to ~1e-6 in f32). Out-of-range targets clamp
+exactly like dense take_along_axis (clip semantics), so flipping
+xent_chunks can never change a loss value.
 """
 
 from __future__ import annotations
@@ -32,19 +46,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["chunked_cross_entropy", "hidden_cross_entropy"]
+__all__ = [
+    "chunked_cross_entropy",
+    "chunked_lse_and_target",
+    "hidden_cross_entropy",
+    "make_vocab_parallel_cross_entropy",
+]
 
 
-def _scan_chunks(x, w, targets, num_chunks: int):
-    """Shared forward scan: returns (lse [N], target_logit [N]).
-
-    targets are clamped to [0, V-1] first — matching the dense path's
-    take_along_axis clip semantics, so flipping xent_chunks can never
-    change the loss of a batch with out-of-range ids."""
+def _scan_chunks(x, w, targets, mask, num_chunks: int):
+    """Forward scan: returns (lse [N], target_logit [N]); target_logit is
+    0 where ``mask`` is False. targets are pre-clamped by callers."""
     n, d = x.shape
     v = w.shape[1]
     vc = v // num_chunks
-    targets = jnp.clip(targets, 0, v - 1)
     w_chunks = w.T.reshape(num_chunks, vc, d)  # [C, Vc, D]
 
     m0 = jnp.full((n,), -jnp.inf, dtype=jnp.float32)
@@ -72,42 +87,48 @@ def _scan_chunks(x, w, targets, num_chunks: int):
         body, (m0, s0, t0),
         (jnp.arange(num_chunks), w_chunks),
     )
-    return m + jnp.log(s), tl
+    return m + jnp.log(s), jnp.where(mask, tl, 0.0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def chunked_cross_entropy(x, w, targets, num_chunks: int = 8):
-    """Mean next-token NLL of softmax(x @ w) rows vs integer targets.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def chunked_lse_and_target(x, w, targets, mask, num_chunks: int = 8):
+    """(lse [N], target_logit [N]) of logits = x @ w, never materializing
+    [N, V]. x: [N, D], w: [D, V] with V % num_chunks == 0, targets: [N]
+    int32 (clamped to [0, V-1]), mask: [N] bool — rows where False report
+    target_logit 0 and receive no onehot gradient (used by the
+    vocab-parallel loss for out-of-shard targets)."""
+    v = w.shape[1]
+    if v % num_chunks:
+        raise ValueError(
+            f"vocab size {v} is not divisible by xent chunk count "
+            f"{num_chunks} (set xent_chunks to a divisor of the vocab)"
+        )
+    t = jnp.clip(targets, 0, v - 1)
+    return _scan_chunks(x, w, t, mask, num_chunks)
 
-    x: [N, D] (any float dtype; matmuls accumulate f32), w: [D, V] with
-    V % num_chunks == 0, targets: [N] int32. Equals
-    ``mean(-log_softmax(x @ w)[i, targets[i]])`` without ever holding
-    [N, V] in memory.
-    """
-    lse, tl = _scan_chunks(x, w, targets, num_chunks)
-    return jnp.mean(lse - tl)
+
+def _lse_fwd(x, w, targets, mask, num_chunks: int):
+    v = w.shape[1]
+    t = jnp.clip(targets, 0, v - 1)
+    lse, tl = _scan_chunks(x, w, t, mask, num_chunks)
+    return (lse, tl), (x, w, t, mask, lse)
 
 
-def _xent_fwd(x, w, targets, num_chunks: int):
-    lse, tl = _scan_chunks(x, w, targets, num_chunks)
-    return jnp.mean(lse - tl), (x, w, targets, lse)
-
-
-def _xent_bwd(num_chunks: int, residuals, g):
-    x, w, targets, lse = residuals
+def _lse_bwd(num_chunks: int, residuals, cotangents):
+    x, w, targets, mask, lse = residuals
+    g_lse, g_tl = cotangents  # [N], [N]
     n, d = x.shape
     v = w.shape[1]
     vc = v // num_chunks
-    targets = jnp.clip(targets, 0, v - 1)  # mirror _scan_chunks
     w_chunks = w.T.reshape(num_chunks, vc, d)  # [C, Vc, D]
-    scale = g / n  # d(mean)/d(nll_i)
+    g_tl = jnp.where(mask, g_tl, 0.0)
 
     dx0 = jnp.zeros((n, d), dtype=jnp.float32)
 
     def body(dx, inputs):
         ci, wc = inputs
         logits_c = (x @ wc.T).astype(jnp.float32)       # [N, Vc]
-        p = jnp.exp(logits_c - lse[:, None])            # [N, Vc]
+        p = jnp.exp(logits_c - lse[:, None])            # d lse / d logits
         local = targets - ci * vc
         in_chunk = (local >= 0) & (local < vc)
         onehot = (
@@ -115,7 +136,7 @@ def _xent_bwd(num_chunks: int, residuals, g):
                            dtype=jnp.float32)
             * in_chunk[:, None]
         )
-        dlogits = (p - onehot) * scale                  # [N, Vc]
+        dlogits = p * g_lse[:, None] + onehot * g_tl[:, None]
         dx = dx + dlogits @ wc.astype(jnp.float32)      # [N, D]
         dwc = dlogits.T @ x.astype(jnp.float32)         # [Vc, D]
         return dx, dwc
@@ -125,17 +146,35 @@ def _xent_bwd(num_chunks: int, residuals, g):
     )
     dw = dw_chunks.reshape(v, d).T  # [D, V]
     zeros_t = np.zeros(targets.shape, dtype=jax.dtypes.float0)
-    return dx.astype(x.dtype), dw.astype(w.dtype), zeros_t
+    zeros_m = np.zeros(mask.shape, dtype=jax.dtypes.float0)
+    return dx.astype(x.dtype), dw.astype(w.dtype), zeros_t, zeros_m
 
 
-chunked_cross_entropy.defvjp(_xent_fwd, _xent_bwd)
+chunked_lse_and_target.defvjp(_lse_fwd, _lse_bwd)
+
+
+def chunked_cross_entropy(x, w, targets, num_chunks: int = 8):
+    """Mean next-token NLL of softmax(x @ w) rows vs integer targets.
+
+    Equals ``mean(-log_softmax(x @ w)[i, targets[i]])`` without ever
+    holding [N, V] in memory (see module docstring).
+    """
+    mask = jnp.ones(targets.shape, dtype=bool)
+    lse, tl = chunked_lse_and_target(x, w, targets, mask, num_chunks)
+    return jnp.mean(lse - tl)
 
 
 def hidden_cross_entropy(h, w, targets, num_chunks: int):
     """Model-facing adapter: mean CE of [B, S, D] hidden states against
     [B, S] targets through vocab projection ``w`` [D, V], chunked. One
     definition so every model family's loss dispatch stays in lockstep
-    (transformer.loss_fn, llama.llama_loss_fn)."""
+    (transformer.loss_fn, llama.llama_loss_fn).
+
+    Assumes an UNSHARDED (replicated) lm head: the chunk reshape + scan
+    is opaque to GSPMD, so a vocab-sharded ``w`` (tp_rules_gpt) may be
+    silently all-gathered here every step. For a TP-sharded head, build
+    the loss with make_vocab_parallel_cross_entropy instead — it runs
+    this same scan per shard and combines with psum."""
     d = h.shape[-1]
     return chunked_cross_entropy(
         h.astype(jnp.float32).reshape(-1, d),
@@ -143,3 +182,69 @@ def hidden_cross_entropy(h, w, targets, num_chunks: int):
         targets.reshape(-1),
         num_chunks,
     )
+
+
+def make_vocab_parallel_cross_entropy(mesh, axis_name: str = "tensor",
+                                      num_chunks: int = 1):
+    """Build a jittable mean-CE loss for a VOCAB-SHARDED lm head.
+
+    Returns ``loss(h, w, targets)`` where h: [N, D] and targets: [N] are
+    replicated over ``axis_name`` and w: [D, V] is sharded on its vocab
+    dim (the tp_rules_gpt/Megatron column-parallel lm head). Each device
+    runs the chunked scan on its local [D, V/tp] shard only; shards
+    combine with a pmax-stabilized logaddexp-psum for the global lse and
+    a psum for the target logit (exactly one shard owns each target).
+    No [N, V] or [N, V/tp] gather ever forms, and gradients flow through
+    the collectives (max-subtraction is gradient-neutral, so the pmax is
+    stop_gradient'ed).
+
+    Inputs/outputs are replicated over every OTHER mesh axis too (specs
+    below say so); compose batch sharding outside if needed.
+    """
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map  # jax >= 0.7
+
+        check_kwargs = {"check_vma": False}
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+
+        check_kwargs = {"check_rep": False}
+
+    def sharded(h, w_local, targets):
+        from jax import lax
+
+        idx = lax.axis_index(axis_name)
+        vloc = w_local.shape[1]
+        v_global = vloc * lax.psum(1, axis_name)
+        # dense-path clip parity for out-of-range ids (see module doc)
+        targets = jnp.clip(targets, 0, v_global - 1)
+        t_loc = targets - idx * vloc
+        mask = (t_loc >= 0) & (t_loc < vloc)
+        lse_loc, tl_loc = chunked_lse_and_target(
+            h.astype(jnp.float32), w_local.astype(jnp.float32),
+            t_loc, mask, num_chunks,
+        )
+        # stabilizer: max over shards of a gradient-stopped copy
+        # (pmax has no differentiation rule; all_gather + max do, and
+        # max-subtraction is gradient-neutral anyway)
+        m = jnp.max(
+            lax.all_gather(lax.stop_gradient(lse_loc), axis_name),
+            axis=0,
+        )
+        lse = m + jnp.log(lax.psum(jnp.exp(lse_loc - m), axis_name))
+        tl = lax.psum(tl_loc, axis_name)
+        return lse - tl  # per-row nll [N]
+
+    f = shard_map(
+        sharded,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis_name), P()),
+        out_specs=P(),
+        **check_kwargs,
+    )
+
+    def loss(h, w, targets):
+        return jnp.mean(f(h, w, targets))
+
+    return loss
