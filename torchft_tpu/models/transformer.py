@@ -240,14 +240,55 @@ def make_train_step(cfg: TransformerConfig, tx,
 
 
 def make_grad_step(cfg: TransformerConfig,
-                   attn_fn: Optional[Callable] = None):
+                   attn_fn: Optional[Callable] = None,
+                   microbatches: int = 1):
     """Jitted (params, tokens, targets) -> (loss, grads): the FT-DDP path
     computes grads on-device, averages them across replica groups over DCN,
-    then applies the optimizer behind the commit gate."""
+    then applies the optimizer behind the commit gate.
+
+    ``microbatches`` > 1 accumulates gradients over that many equal
+    slices of the batch via lax.scan — one compiled program, activation
+    memory of a single slice, identical mean-loss semantics (each slice
+    is the same size, so averaging slice means equals the full-batch
+    mean). The knob large effective batches need under a fixed HBM
+    budget; the batch dim must divide evenly."""
 
     def step(params, tokens, targets):
-        return jax.value_and_grad(
-            lambda p: loss_fn(cfg, p, tokens, targets, attn_fn)
-        )(params)
+        if microbatches <= 1:
+            return jax.value_and_grad(
+                lambda p: loss_fn(cfg, p, tokens, targets, attn_fn)
+            )(params)
+        b = tokens.shape[0]
+        if b % microbatches:
+            raise ValueError(
+                f"batch {b} not divisible by microbatches {microbatches}"
+            )
+        mb = b // microbatches
+        tok_mb = tokens.reshape(microbatches, mb, *tokens.shape[1:])
+        tgt_mb = targets.reshape(microbatches, mb, *targets.shape[1:])
+
+        def body(carry, xs):
+            loss_acc, grad_acc = carry
+            tok, tgt = xs
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(cfg, p, tok, tgt, attn_fn)
+            )(params)
+            return (
+                loss_acc + loss,
+                jax.tree_util.tree_map(jnp.add, grad_acc, grads),
+            ), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zeros), (tok_mb, tgt_mb)
+        )
+        inv = 1.0 / microbatches
+        # accumulate f32 regardless of param dtype; hand back param-dtype
+        # grads so both microbatch settings feed ddp/optim identically
+        return loss_sum * inv, jax.tree_util.tree_map(
+            lambda g, p: (g * inv).astype(p.dtype), grad_sum, params
+        )
 
     return jax.jit(step)

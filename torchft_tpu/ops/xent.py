@@ -59,6 +59,12 @@ def _scan_chunks(x, w, targets, mask, num_chunks: int):
     0 where ``mask`` is False. targets are pre-clamped by callers."""
     n, d = x.shape
     v = w.shape[1]
+    # checked here so both the primal AND the custom-VJP forward hit it
+    if v % num_chunks:
+        raise ValueError(
+            f"vocab size {v} is not divisible by xent chunk count "
+            f"{num_chunks} (set xent_chunks to a divisor of the vocab)"
+        )
     vc = v // num_chunks
     w_chunks = w.T.reshape(num_chunks, vc, d)  # [C, Vc, D]
 
@@ -97,13 +103,7 @@ def chunked_lse_and_target(x, w, targets, mask, num_chunks: int = 8):
     int32 (clamped to [0, V-1]), mask: [N] bool — rows where False report
     target_logit 0 and receive no onehot gradient (used by the
     vocab-parallel loss for out-of-shard targets)."""
-    v = w.shape[1]
-    if v % num_chunks:
-        raise ValueError(
-            f"vocab size {v} is not divisible by xent chunk count "
-            f"{num_chunks} (set xent_chunks to a divisor of the vocab)"
-        )
-    t = jnp.clip(targets, 0, v - 1)
+    t = jnp.clip(targets, 0, w.shape[1] - 1)
     return _scan_chunks(x, w, t, mask, num_chunks)
 
 
@@ -202,14 +202,10 @@ def make_vocab_parallel_cross_entropy(mesh, axis_name: str = "tensor",
     below say so); compose batch sharding outside if needed.
     """
     from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map  # jax >= 0.7
 
-        check_kwargs = {"check_vma": False}
-    except ImportError:  # pragma: no cover — older jax
-        from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+    from torchft_tpu.parallel.pipeline import _get_shard_map
 
-        check_kwargs = {"check_rep": False}
+    shard_map, check_kwargs = _get_shard_map()
 
     def sharded(h, w_local, targets):
         from jax import lax

@@ -278,16 +278,11 @@ def make_ring_attention(mesh, axis_name: str = "seq", causal: bool = True,
     flash path carries a ring-structured FlashAttention-2 custom VJP
     (kv blocks and their dk/dv accumulators rotate together; see
     _ring_flash_vjp_bwd)."""
-    import jax
     from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map  # jax >= 0.7
 
-        check_kwargs = {"check_vma": False}
-    except ImportError:  # pragma: no cover — older jax
-        from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+    from torchft_tpu.parallel.pipeline import _get_shard_map
 
-        check_kwargs = {"check_rep": False}
+    shard_map, check_kwargs = _get_shard_map()
 
     spec = P(None, axis_name, None, None)
     if block_impl == "flash":
