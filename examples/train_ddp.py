@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import logging
 import os
-import pickle
 import sys
 
 logging.basicConfig(
@@ -40,6 +39,7 @@ from torchft_tpu import (
     OptimizerWrapper,
     TcpCommContext,
 )
+from torchft_tpu.checkpoint_io import AsyncCheckpointWriter, load_checkpoint
 from torchft_tpu.comm.store import StoreServer
 from torchft_tpu.models import CONFIGS, init_params, make_grad_step
 
@@ -108,13 +108,30 @@ def main() -> None:
     grad_step = make_grad_step(cfg)
 
     # Durable-checkpoint resume is the user's job (ref train_ddp.py:141-148)
-    # — the manager state_dict MUST be part of it.
-    if os.path.exists(ckpt_path):
-        with open(ckpt_path, "rb") as f:
-            saved = pickle.load(f)
+    # — the manager state_dict MUST be part of it. Checkpoints are
+    # step-suffixed so keep=2 retains a previous-step fallback; resume
+    # from the newest.
+    def _existing_ckpts():
+        d, base = os.path.split(ckpt_path)
+        found = []
+        for name in os.listdir(d or "."):
+            if name.startswith(base + "."):
+                try:
+                    found.append((int(name.rsplit(".", 1)[1]),
+                                  os.path.join(d, name)))
+                except ValueError:
+                    pass
+        return [p for _, p in sorted(found)]
+
+    existing = _existing_ckpts()
+    if existing:
+        newest = existing[-1]
+        saved = load_checkpoint(newest)
         load_state_dict(saved["user"])
         manager.load_state_dict(saved["manager"])
-        print(f"resumed from {ckpt_path} at step {manager.current_step()}")
+        print(f"resumed from {newest} at step {manager.current_step()}")
+    # stage-on-call + background persist: training never waits on disk
+    ckpt_writer = AsyncCheckpointWriter(keep=2)
 
     batch_size = 8
     it = iter(sampler)
@@ -131,35 +148,37 @@ def main() -> None:
         tokens = jnp.asarray(dataset[idx], dtype=jnp.int32)
         return tokens, jnp.roll(tokens, -1, axis=1)
 
-    while manager.current_step() < total_steps:
-        tokens, targets = next_batch()
-        opt.begin_step()
-        loss, grads = grad_step(state["params"], tokens, targets)
-        avg = ddp.average_gradients(grads)
-        new_params, new_opt, committed = opt.step(
-            state["params"], state["opt"], avg
-        )
-        if committed:
-            state["params"], state["opt"] = new_params, new_opt
-            step = manager.current_step()
-            print(
-                f"[group {replica_group}] step {step} "
-                f"loss {float(loss):.4f} "
-                f"participants {manager.num_participants()}"
+    try:
+        while manager.current_step() < total_steps:
+            tokens, targets = next_batch()
+            opt.begin_step()
+            loss, grads = grad_step(state["params"], tokens, targets)
+            avg = ddp.average_gradients(grads)
+            new_params, new_opt, committed = opt.step(
+                state["params"], state["opt"], avg
             )
-            if step % 10 == 0:
-                with open(ckpt_path, "wb") as f:
-                    pickle.dump(
+            if committed:
+                state["params"], state["opt"] = new_params, new_opt
+                step = manager.current_step()
+                print(
+                    f"[group {replica_group}] step {step} "
+                    f"loss {float(loss):.4f} "
+                    f"participants {manager.num_participants()}"
+                )
+                if step % 10 == 0:
+                    ckpt_writer.save(
+                        f"{ckpt_path}.{step}",
                         {
                             "user": state_dict(),
                             "manager": manager.state_dict(),
                         },
-                        f,
                     )
-
-    manager.shutdown()
-    if store is not None:
-        store.shutdown()
+        # drain pending writes; surface write errors before "done"
+        ckpt_writer.close()
+    finally:
+        manager.shutdown()
+        if store is not None:
+            store.shutdown()
     print(f"[group {replica_group}] done at step {manager.current_step()}")
 
 

@@ -136,3 +136,52 @@ def test_train_hsdp_example_runs() -> None:
         assert "step 3" in proc.stdout, proc.stdout
     finally:
         lh.shutdown()
+
+
+def test_train_ddp_example_durable_resume(tmp_path) -> None:
+    # The DDP example's durable checkpoints are written by the async
+    # writer; a second run with the same CKPT_PATH must resume from the
+    # persisted step, not step 0 — the apps-level seal on stage-on-call
+    # + background-persist durability.
+    import os
+
+    from torchft_tpu.control import Lighthouse
+
+    ckpt = str(tmp_path / "ddp.ckpt")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run(total_steps: int):
+        lh = Lighthouse(min_replicas=1, join_timeout_ms=200)
+        env = dict(os.environ)
+        env.update(
+            TORCHFT_TPU_LIGHTHOUSE=lh.address(),
+            TOTAL_STEPS=str(total_steps),
+            NUM_REPLICA_GROUPS="1",
+            REPLICA_GROUP_ID="0",
+            CKPT_PATH=ckpt,
+            LOGLEVEL="ERROR",
+            JAX_PLATFORMS="cpu",
+        )
+        env.pop("PYTHONPATH", None)  # drop the axon sitecustomize
+        try:
+            return subprocess.run(
+                [sys.executable, "examples/train_ddp.py"],
+                env=env, capture_output=True, text=True, timeout=120,
+                cwd=repo,
+            )
+        finally:
+            lh.shutdown()
+
+    first = run(10)
+    assert first.returncode == 0, first.stderr[-2000:]
+    assert "step 10" in first.stdout, first.stdout
+    assert os.path.exists(ckpt + ".10")  # step-suffixed durable file
+
+    second = run(13)
+    assert second.returncode == 0, second.stderr[-2000:]
+    assert "resumed from" in second.stdout, second.stdout
+    # resumed past the first run's checkpoint; never reprints step 1
+    assert "step 13" in second.stdout, second.stdout
+    assert "step 1 " not in second.stdout.replace("step 10", ""), (
+        second.stdout
+    )

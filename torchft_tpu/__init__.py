@@ -13,6 +13,10 @@ Public API parity target: ref torchft/__init__.py:7-20.
 
 __version__ = "0.1.0"
 
+from torchft_tpu.checkpoint_io import (  # noqa: F401
+    AsyncCheckpointWriter,
+    load_checkpoint,
+)
 from torchft_tpu.checkpointing import (  # noqa: F401
     CheckpointServer,
     CheckpointTransport,
@@ -42,6 +46,7 @@ from torchft_tpu.optim import OptimizerWrapper as Optimizer  # noqa: F401
 from torchft_tpu.optim import OptimizerWrapper  # noqa: F401
 
 __all__ = [
+    "AsyncCheckpointWriter",
     "CheckpointServer",
     "CheckpointTransport",
     "CommContext",
@@ -56,6 +61,7 @@ __all__ = [
     "Optimizer",
     "OptimizerWrapper",
     "PureDistributedDataParallel",
+    "load_checkpoint",
     "ReduceOp",
     "SubprocessCommContext",
     "TcpCommContext",

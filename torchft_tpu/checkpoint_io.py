@@ -1,0 +1,163 @@
+"""Async durable checkpointing: stage on-call, persist in the background.
+
+The live-heal plane (checkpointing.py) moves state replica-to-replica
+over HTTP; THIS module is the other half of the checkpoint story —
+durable snapshots to disk that training resumes from after a full-group
+restart (the reference leaves durable saving to user code around
+torch.distributed.checkpoint, e.g. its train_ddp example writing
+synchronously; here it is a framework component).
+
+Design: ``save()`` splits into the two phases async checkpointing always
+has on an accelerator:
+
+1. STAGE (synchronous, on the caller): device→host copy of the pytree.
+   This cannot be deferred — the train step donates its input buffers
+   (models/transformer.py make_train_step), so the device arrays the
+   caller passes may be invalidated by the very next step. The copy runs
+   at PCIe/ICI D2H speed and is the only part training waits for.
+2. PERSIST (asynchronous, single worker thread): pickle the host tree to
+   ``path + ".tmp"``, fsync, then os.replace into place — a reader never
+   observes a torn file — and prune old checkpoints beyond ``keep``.
+
+Failures in the background write are latched and re-raised on the next
+``save()`` or ``wait()`` — the same error-latching discipline as the FT
+runtime (a checkpoint failure must surface, not vanish into a thread).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from concurrent.futures import (
+    Future,
+    ThreadPoolExecutor,
+    TimeoutError as FuturesTimeoutError,
+)
+from typing import Any, List, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["AsyncCheckpointWriter", "load_checkpoint"]
+
+
+def load_checkpoint(path: str) -> Any:
+    """Read a checkpoint written by AsyncCheckpointWriter (host numpy
+    pytree; pickle over a trusted filesystem, same trust model as the
+    reference's torch.load-based resume)."""
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+class AsyncCheckpointWriter:
+    """Serialize durable checkpoint writes onto one background thread.
+
+    keep: how many most-recent checkpoint files to retain (older files
+    this writer wrote are deleted after each successful write); 0 keeps
+    everything.
+    """
+
+    def __init__(self, keep: int = 3):
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt-writer"
+        )
+        self._keep = keep
+        self._written: List[str] = []  # newest last; only OUR files
+        self._lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+        self._last: Optional[Future] = None
+
+    # ---------------------------------------------------------------- api
+    def save(self, path: str, pytree: Any) -> Future:
+        """Stage ``pytree`` to host now; persist to ``path`` in the
+        background. Returns the write's Future (resolves to ``path``).
+        Raises any error latched from a previous background write.
+
+        Backpressure: at most one write is in flight — if the previous
+        write hasn't finished, save() blocks on it BEFORE staging, so a
+        disk slower than the save cadence throttles the saver instead of
+        queueing unbounded full host copies of the model."""
+        if self._last is not None and not self._last.done():
+            try:
+                self._last.result()
+            except BaseException:
+                pass  # latched; surfaced by raise_if_failed below
+        self.raise_if_failed()
+        host_tree = jax.tree_util.tree_map(self._to_host, pytree)
+        fut = self._executor.submit(self._persist, path, host_tree)
+        self._last = fut
+        return fut
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Block until the most recent save has persisted; re-raise (and
+        clear) its error if it failed."""
+        if self._last is not None:
+            try:
+                self._last.result(timeout)
+            except FuturesTimeoutError:
+                raise
+            except BaseException:
+                pass  # latched; re-raised once by raise_if_failed
+        self.raise_if_failed()
+
+    def raise_if_failed(self) -> None:
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise RuntimeError(
+                "background checkpoint write failed"
+            ) from err
+
+    def close(self) -> None:
+        """Drain pending writes and stop the worker. Raises if the final
+        write failed."""
+        self._executor.shutdown(wait=True)
+        self.raise_if_failed()
+
+    def __enter__(self) -> "AsyncCheckpointWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ internal
+    @staticmethod
+    def _to_host(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(jax.device_get(x))
+        if isinstance(x, np.ndarray):
+            # host arrays may be mutated in place by the trainer while
+            # the background thread pickles — snapshot them too
+            return np.array(x, copy=True)
+        return x
+
+    def _persist(self, path: str, host_tree: Any) -> str:
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(host_tree, f, protocol=5)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)  # atomic: readers never see torn files
+            self._prune(path)
+            return path
+        except BaseException as e:  # latch for the training thread
+            with self._lock:
+                self._error = e
+            raise
+
+    def _prune(self, newest: str) -> None:
+        with self._lock:
+            if newest in self._written:
+                self._written.remove(newest)  # re-save to same path
+            self._written.append(newest)
+            if self._keep <= 0:
+                return
+            excess = self._written[: -self._keep]
+            self._written = self._written[-self._keep:]
+        for old in excess:
+            try:
+                os.remove(old)
+            except OSError:
+                pass  # already gone / never ours to delete

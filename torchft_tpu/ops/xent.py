@@ -203,9 +203,9 @@ def make_vocab_parallel_cross_entropy(mesh, axis_name: str = "tensor",
     """
     from jax.sharding import PartitionSpec as P
 
-    from torchft_tpu.parallel.pipeline import _get_shard_map
+    from torchft_tpu.utils.jaxcompat import get_shard_map
 
-    shard_map, check_kwargs = _get_shard_map()
+    shard_map, check_kwargs = get_shard_map()
 
     def sharded(h, w_local, targets):
         from jax import lax

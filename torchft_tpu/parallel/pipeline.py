@@ -75,15 +75,7 @@ def merge_microbatches(x):
     return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
 
 
-def _get_shard_map():
-    try:
-        from jax import shard_map
-
-        return shard_map, {"check_vma": False}
-    except ImportError:  # pragma: no cover — older jax
-        from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
-
-        return shard_map, {"check_rep": False}
+from torchft_tpu.utils.jaxcompat import get_shard_map as _get_shard_map
 
 
 def make_pipeline(mesh, stage_fn: Callable[[Any, Any], Any],

@@ -280,9 +280,9 @@ def make_ring_attention(mesh, axis_name: str = "seq", causal: bool = True,
     _ring_flash_vjp_bwd)."""
     from jax.sharding import PartitionSpec as P
 
-    from torchft_tpu.parallel.pipeline import _get_shard_map
+    from torchft_tpu.utils.jaxcompat import get_shard_map
 
-    shard_map, check_kwargs = _get_shard_map()
+    shard_map, check_kwargs = get_shard_map()
 
     spec = P(None, axis_name, None, None)
     if block_impl == "flash":

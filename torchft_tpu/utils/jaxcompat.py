@@ -1,0 +1,21 @@
+"""jax version-compatibility shims shared across the package."""
+
+from __future__ import annotations
+
+__all__ = ["get_shard_map"]
+
+
+def get_shard_map():
+    """Return (shard_map, check_kwargs) across jax versions: jax >= 0.7
+    exports jax.shard_map with check_vma; older versions have the
+    experimental module with check_rep. One definition — used by
+    parallel/pipeline.py, parallel/ring.py, and ops/xent.py — so the
+    next jax API shift is a one-line fix."""
+    try:
+        from jax import shard_map  # jax >= 0.7
+
+        return shard_map, {"check_vma": False}
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+
+        return shard_map, {"check_rep": False}
