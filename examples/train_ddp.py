@@ -39,7 +39,11 @@ from torchft_tpu import (
     OptimizerWrapper,
     TcpCommContext,
 )
-from torchft_tpu.checkpoint_io import AsyncCheckpointWriter, load_checkpoint
+from torchft_tpu.checkpoint_io import (
+    AsyncCheckpointWriter,
+    latest_checkpoint,
+    load_checkpoint,
+)
 from torchft_tpu.comm.store import StoreServer
 from torchft_tpu.models import CONFIGS, init_params, make_grad_step
 
@@ -109,23 +113,10 @@ def main() -> None:
 
     # Durable-checkpoint resume is the user's job (ref train_ddp.py:141-148)
     # — the manager state_dict MUST be part of it. Checkpoints are
-    # step-suffixed so keep=2 retains a previous-step fallback; resume
-    # from the newest.
-    def _existing_ckpts():
-        d, base = os.path.split(ckpt_path)
-        found = []
-        for name in os.listdir(d or "."):
-            if name.startswith(base + "."):
-                try:
-                    found.append((int(name.rsplit(".", 1)[1]),
-                                  os.path.join(d, name)))
-                except ValueError:
-                    pass
-        return [p for _, p in sorted(found)]
-
-    existing = _existing_ckpts()
-    if existing:
-        newest = existing[-1]
+    # step-suffixed so keep=2 retains a previous-step fallback (retention
+    # spans kill/relaunch incarnations); resume from the newest.
+    newest = latest_checkpoint(ckpt_path)
+    if newest is not None:
         saved = load_checkpoint(newest)
         load_state_dict(saved["user"])
         manager.load_state_dict(saved["manager"])
@@ -166,8 +157,8 @@ def main() -> None:
                     f"participants {manager.num_participants()}"
                 )
                 if step % 10 == 0:
-                    ckpt_writer.save(
-                        f"{ckpt_path}.{step}",
+                    ckpt_writer.save_step(
+                        ckpt_path, step,
                         {
                             "user": state_dict(),
                             "manager": manager.state_dict(),

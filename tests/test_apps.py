@@ -185,3 +185,35 @@ def test_train_ddp_example_durable_resume(tmp_path) -> None:
     assert "step 1 " not in second.stdout.replace("step 10", ""), (
         second.stdout
     )
+
+
+def test_train_llama_ring_example_runs() -> None:
+    # Llama (GQA/RoPE/SwiGLU) x ring attention (sequence parallelism)
+    # x chunked CE x FT manager, end-to-end as a real subprocess — the
+    # apps-level seal on the long-context composition.
+    import os
+
+    from torchft_tpu.control import Lighthouse
+
+    lh = Lighthouse(min_replicas=1, join_timeout_ms=200)
+    env = dict(os.environ)
+    env.update(
+        TORCHFT_TPU_LIGHTHOUSE=lh.address(),
+        TOTAL_STEPS="3",
+        REPLICA_GROUP_ID="0",
+        SEQ_LEN="128",
+        LOGLEVEL="ERROR",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+    )
+    env.pop("PYTHONPATH", None)  # drop the axon sitecustomize
+    try:
+        proc = subprocess.run(
+            [sys.executable, "examples/train_llama_ring.py"],
+            env=env, capture_output=True, text=True, timeout=180,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "step 3" in proc.stdout, proc.stdout
+    finally:
+        lh.shutdown()

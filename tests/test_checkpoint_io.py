@@ -73,7 +73,10 @@ def test_atomic_no_torn_files(tmp_path) -> None:
 
 def test_write_error_latches_and_raises(tmp_path) -> None:
     w = AsyncCheckpointWriter()
-    bad = str(tmp_path / "no_such_dir" / "x.pkl")
+    # parent "directory" is a regular file: the write must fail
+    blocker = tmp_path / "blocker"
+    blocker.write_bytes(b"")
+    bad = str(blocker / "x.pkl")
     fut = w.save(bad, _tree(0))
     with pytest.raises(Exception):
         fut.result(30)
@@ -107,3 +110,40 @@ def test_backpressure_one_write_in_flight(tmp_path) -> None:
     w.save(str(tmp_path / "b.pkl"), _tree(2))
     assert f1.done()  # previous write finished before the new staging
     w.close()
+
+
+def test_save_step_retention_spans_restarts(tmp_path) -> None:
+    # a fresh writer (new process incarnation) must count files written
+    # by prior incarnations toward keep-last-k — the FT crash loop must
+    # not grow disk unboundedly
+    from torchft_tpu.checkpoint_io import latest_checkpoint
+
+    base = str(tmp_path / "run.ckpt")
+    with AsyncCheckpointWriter(keep=2) as w1:
+        for s in (10, 20):
+            w1.save_step(base, s, _tree(s))
+    # "relaunch": a new writer instance
+    with AsyncCheckpointWriter(keep=2) as w2:
+        w2.save_step(base, 30, _tree(30))
+    names = sorted(os.listdir(tmp_path))
+    assert names == ["run.ckpt.20", "run.ckpt.30"], names
+    assert latest_checkpoint(base).endswith(".30")
+
+
+def test_latest_checkpoint_legacy_bare_path(tmp_path) -> None:
+    # a pre-step-suffix checkpoint at the bare path must still resume
+    from torchft_tpu.checkpoint_io import latest_checkpoint
+
+    base = str(tmp_path / "old.ckpt")
+    with open(base, "wb") as f:
+        pickle.dump({"step": 5}, f)
+    assert latest_checkpoint(base) == base
+    assert latest_checkpoint(str(tmp_path / "missing")) is None
+    assert latest_checkpoint(str(tmp_path / "nodir" / "x")) is None
+
+
+def test_persist_creates_parent_dirs(tmp_path) -> None:
+    path = str(tmp_path / "deep" / "nested" / "c.pkl")
+    with AsyncCheckpointWriter() as w:
+        assert w.save(path, _tree(1)).result(30) == path
+    assert load_checkpoint(path)["step"] == 1

@@ -34,12 +34,15 @@ from concurrent.futures import (
     ThreadPoolExecutor,
     TimeoutError as FuturesTimeoutError,
 )
-from typing import Any, List, Optional
+from typing import Any, List, Optional, Tuple
 
-import jax
-import numpy as np
+from torchft_tpu.utils.serialization import pytree_to_stream, to_host
 
-__all__ = ["AsyncCheckpointWriter", "load_checkpoint"]
+__all__ = [
+    "AsyncCheckpointWriter",
+    "latest_checkpoint",
+    "load_checkpoint",
+]
 
 
 def load_checkpoint(path: str) -> Any:
@@ -48,6 +51,36 @@ def load_checkpoint(path: str) -> Any:
     reference's torch.load-based resume)."""
     with open(path, "rb") as f:
         return pickle.load(f)
+
+
+def _step_checkpoints(base_path: str) -> List[Tuple[int, str]]:
+    """(step, path) for every ``{base_path}.{int}`` on disk, ascending."""
+    d, base = os.path.split(base_path)
+    found = []
+    try:
+        names = os.listdir(d or ".")
+    except FileNotFoundError:
+        return []
+    for name in names:
+        if name.startswith(base + "."):
+            try:
+                found.append((int(name.rsplit(".", 1)[1]),
+                              os.path.join(d, name)))
+            except ValueError:
+                pass
+    return sorted(found)
+
+
+def latest_checkpoint(base_path: str) -> Optional[str]:
+    """Newest ``{base_path}.{step}`` file, falling back to a bare
+    ``base_path`` written by an un-suffixed saver. None if neither
+    exists."""
+    steps = _step_checkpoints(base_path)
+    if steps:
+        return steps[-1][1]
+    if os.path.exists(base_path):
+        return base_path
+    return None
 
 
 class AsyncCheckpointWriter:
@@ -63,7 +96,8 @@ class AsyncCheckpointWriter:
             max_workers=1, thread_name_prefix="ckpt-writer"
         )
         self._keep = keep
-        self._written: List[str] = []  # newest last; only OUR files
+        self._written: List[str] = []  # newest last
+        self._seeded_bases: set = set()
         self._lock = threading.Lock()
         self._error: Optional[BaseException] = None
         self._last: Optional[Future] = None
@@ -84,10 +118,27 @@ class AsyncCheckpointWriter:
             except BaseException:
                 pass  # latched; surfaced by raise_if_failed below
         self.raise_if_failed()
-        host_tree = jax.tree_util.tree_map(self._to_host, pytree)
+        host_tree = to_host(pytree, snapshot=True)
         fut = self._executor.submit(self._persist, path, host_tree)
         self._last = fut
         return fut
+
+    def save_step(self, base_path: str, step: int, pytree: Any) -> Future:
+        """``save()`` under the step-suffix convention:
+        ``{base_path}.{step}``. Retention spans process restarts — the
+        first save for a base seeds the prune list from files already on
+        disk (prior incarnations of a kill/relaunched trainer), so
+        keep-last-k holds across the FT crash loop, not just within one
+        life. Pair with ``latest_checkpoint(base_path)`` for resume."""
+        with self._lock:
+            if base_path not in self._seeded_bases:
+                self._seeded_bases.add(base_path)
+                prior = [
+                    p for _, p in _step_checkpoints(base_path)
+                    if p not in self._written
+                ]
+                self._written = prior + self._written  # oldest first
+        return self.save(f"{base_path}.{step}", pytree)
 
     def wait(self, timeout: Optional[float] = None) -> None:
         """Block until the most recent save has persisted; re-raise (and
@@ -122,21 +173,14 @@ class AsyncCheckpointWriter:
         self.close()
 
     # ------------------------------------------------------------ internal
-    @staticmethod
-    def _to_host(x):
-        if isinstance(x, jax.Array):
-            return np.asarray(jax.device_get(x))
-        if isinstance(x, np.ndarray):
-            # host arrays may be mutated in place by the trainer while
-            # the background thread pickles — snapshot them too
-            return np.array(x, copy=True)
-        return x
-
     def _persist(self, path: str, host_tree: Any) -> str:
         try:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
-                pickle.dump(host_tree, f, protocol=5)
+                pytree_to_stream(host_tree, f, convert=False)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)  # atomic: readers never see torn files

@@ -22,13 +22,19 @@ __all__ = ["pytree_to_stream", "pytree_from_stream", "pytree_to_bytes",
            "pytree_from_bytes", "to_host"]
 
 
-def to_host(tree: Any) -> Any:
-    """Convert all jax.Array leaves to numpy (device→host)."""
+def to_host(tree: Any, snapshot: bool = False) -> Any:
+    """Convert all jax.Array leaves to numpy (device→host).
+
+    ``snapshot=True`` also deep-copies numpy leaves, so a tree the
+    trainer mutates in place can be handed to a background thread
+    (checkpoint_io.py's stage-on-call contract)."""
     import jax
 
     def _leaf(x: Any) -> Any:
         if isinstance(x, jax.Array):
             return np.asarray(jax.device_get(x))
+        if snapshot and isinstance(x, np.ndarray):
+            return np.array(x, copy=True)
         return x
 
     return jax.tree_util.tree_map(_leaf, tree)
