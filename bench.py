@@ -556,6 +556,11 @@ def _run() -> None:
         attn_fn, attn_label, flash_speedup, flash_err = None, "xla", 0.0, 0.0
 
     # ---- T0: fault-free fused train step --------------------------------
+    # TORCHFT_TPU_PROFILE_DIR=/tmp/trace captures an XLA trace of a few
+    # T0 steps (utils/profiling.py); disabled = two integer compares.
+    from torchft_tpu.utils.profiling import StepProfiler
+
+    profiler = StepProfiler()
     step_fused = make_train_step(cfg, tx, attn_fn=attn_fn, donate=True)
     p0, s0 = params, tx.init(params)
     for _ in range(warmup):
@@ -564,8 +569,10 @@ def _run() -> None:
     t_start = time.perf_counter()
     for _ in range(steps):
         p0, s0, loss = step_fused(p0, s0, tokens, targets)
+        profiler.step()
     _sync(loss)
     t0_elapsed = time.perf_counter() - t_start
+    profiler.close()
     t0 = tokens_per_step * steps / t0_elapsed
     del p0, s0
 

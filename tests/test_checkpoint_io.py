@@ -147,3 +147,18 @@ def test_persist_creates_parent_dirs(tmp_path) -> None:
     with AsyncCheckpointWriter() as w:
         assert w.save(path, _tree(1)).result(30) == path
     assert load_checkpoint(path)["step"] == 1
+
+
+def test_step_checkpoints_ignore_foreign_families(tmp_path) -> None:
+    # "base.ema.50" / "base.backup.2" are different families: never
+    # resumed from, never pruned by this writer
+    from torchft_tpu.checkpoint_io import latest_checkpoint
+
+    base = str(tmp_path / "run.ckpt")
+    for name in ("run.ckpt.ema.50", "run.ckpt.backup.2", "run.ckpt.tmp"):
+        (tmp_path / name).write_bytes(b"x")
+    with AsyncCheckpointWriter(keep=1) as w:
+        w.save_step(base, 10, _tree(10))
+    assert latest_checkpoint(base).endswith("run.ckpt.10")
+    names = sorted(os.listdir(tmp_path))
+    assert "run.ckpt.ema.50" in names and "run.ckpt.backup.2" in names

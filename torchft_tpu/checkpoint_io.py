@@ -62,12 +62,12 @@ def _step_checkpoints(base_path: str) -> List[Tuple[int, str]]:
     except FileNotFoundError:
         return []
     for name in names:
-        if name.startswith(base + "."):
-            try:
-                found.append((int(name.rsplit(".", 1)[1]),
-                              os.path.join(d, name)))
-            except ValueError:
-                pass
+        # the ENTIRE suffix after "base." must be digits — a sibling
+        # like "base.ema.50" or "base.backup.2" is a different family
+        # and must never be resumed from or pruned by this writer
+        suffix = name[len(base) + 1:]
+        if name.startswith(base + ".") and suffix.isdigit():
+            found.append((int(suffix), os.path.join(d, name)))
     return sorted(found)
 
 

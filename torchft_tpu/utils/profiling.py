@@ -1,0 +1,103 @@
+"""XLA profiler integration: capture device traces for a step window.
+
+The reference ships no profiler hook (SURVEY.md §5); on TPU the natural
+tool is jax.profiler — its traces capture XLA op timelines, HBM traffic,
+and ICI collectives, viewable in TensorBoard/Perfetto. This wraps it in
+the two shapes training loops want:
+
+- ``StepProfiler``: profile steps [start, stop) of a loop, driven by env
+  vars so ANY trainer (bench.py, the examples) can be profiled without
+  code changes: TORCHFT_TPU_PROFILE_DIR=/tmp/trace
+  TORCHFT_TPU_PROFILE_START=10 TORCHFT_TPU_PROFILE_STEPS=5.
+- ``trace()``: a context manager for one-off blocks.
+
+Profiling is strictly zero-cost when TORCHFT_TPU_PROFILE_DIR is unset:
+``step()`` is two integer compares.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Optional
+
+__all__ = ["StepProfiler", "trace"]
+
+
+@contextmanager
+def trace(log_dir: str):
+    """Profile the enclosed block into ``log_dir`` (TensorBoard/Perfetto
+    readable)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepProfiler:
+    """Trace a window of training steps, configured by env or args.
+
+    Call ``step()`` once per loop iteration. The trace starts when the
+    step counter reaches ``start`` and stops after ``num_steps`` more;
+    ``close()`` (or program exit via ``__del__``) stops a still-open
+    trace if the loop ends early.
+    """
+
+    def __init__(self, log_dir: Optional[str] = None,
+                 start: Optional[int] = None,
+                 num_steps: Optional[int] = None):
+        self.log_dir = (
+            log_dir
+            if log_dir is not None
+            else os.environ.get("TORCHFT_TPU_PROFILE_DIR")
+        )
+        self.start = (
+            start
+            if start is not None
+            else int(os.environ.get("TORCHFT_TPU_PROFILE_START", "3"))
+        )
+        self.num_steps = (
+            num_steps
+            if num_steps is not None
+            else int(os.environ.get("TORCHFT_TPU_PROFILE_STEPS", "5"))
+        )
+        self._step = 0
+        self._active = False
+        self._done = self.log_dir is None  # disabled: step() is a no-op
+
+    @property
+    def enabled(self) -> bool:
+        return self.log_dir is not None
+
+    def step(self) -> None:
+        """Advance the step counter; start/stop the trace at the window
+        edges."""
+        if self._done:
+            return
+        import jax
+
+        if not self._active and self._step == self.start:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and self._step >= self.start + self.num_steps:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+        self._step += 1
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+        self._done = True
+
+    def __del__(self):  # pragma: no cover — best-effort
+        try:
+            self.close()
+        except Exception:
+            pass
