@@ -177,14 +177,15 @@ void* ft_manager_client_new(const char* addr, uint64_t connect_timeout_ms,
 
 char* ft_manager_client_quorum(void* handle, int64_t rank, int64_t step,
                                const char* checkpoint_metadata,
-                               int shrink_only, uint64_t timeout_ms,
-                               char** err) {
+                               int shrink_only, int data_plane,
+                               uint64_t timeout_ms, char** err) {
   auto* c = static_cast<ClientHandle*>(handle);
   ftjson::Object req;
   req["rank"] = rank;
   req["step"] = step;
   req["checkpoint_metadata"] = std::string(checkpoint_metadata);
   req["shrink_only"] = shrink_only != 0;
+  req["data_plane"] = data_plane != 0;
   std::string out;
   if (!client_post(c, "/torchft.ManagerService/Quorum",
                    ftjson::Value(req).dump(),

@@ -92,12 +92,14 @@ Response ManagerServer::handle_quorum(const Request& req) {
   int64_t rank, step;
   std::string ckpt_meta;
   bool shrink_only;
+  bool data_plane = true;
   try {
     auto body = ftjson::Value::parse(req.body);
     rank = body.get_int("rank");
     step = body.get_int("step");
     ckpt_meta = body.get_str("checkpoint_metadata");
     shrink_only = body.get_bool("shrink_only");
+    data_plane = body.get_bool("data_plane", true);
   } catch (const std::exception& e) {
     return Response{400, "application/json",
                     std::string("{\"error\":\"") + e.what() + "\"}"};
@@ -122,6 +124,7 @@ Response ManagerServer::handle_quorum(const Request& req) {
     self.step = step;
     self.world_size = opts_.world_size;
     self.shrink_only = shrink_only;
+    self.data_plane = data_plane;
 
     lk.unlock();
     std::string host;

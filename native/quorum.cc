@@ -15,6 +15,7 @@ ftjson::Value Member::to_json() const {
   o["step"] = step;
   o["world_size"] = static_cast<int64_t>(world_size);
   o["shrink_only"] = shrink_only;
+  o["data_plane"] = data_plane;
   return ftjson::Value(std::move(o));
 }
 
@@ -26,6 +27,7 @@ Member Member::from_json(const ftjson::Value& v) {
   m.step = v.get_int("step");
   m.world_size = static_cast<uint64_t>(v.get_int("world_size", 1));
   m.shrink_only = v.get_bool("shrink_only");
+  m.data_plane = v.get_bool("data_plane", true);
   return m;
 }
 
@@ -180,6 +182,16 @@ ftjson::Value QuorumResults::to_json() const {
   o["max_rank"] = max_rank.has_value() ? ftjson::Value(*max_rank)
                                        : ftjson::Value(nullptr);
   o["max_world_size"] = max_world_size;
+  ftjson::Array ids;
+  for (const auto& id : max_replica_ids) ids.push_back(id);
+  o["max_replica_ids"] = ftjson::Value(std::move(ids));
+  o["transport_rank"] = transport_rank.has_value()
+                            ? ftjson::Value(*transport_rank)
+                            : ftjson::Value(nullptr);
+  o["transport_world_size"] = transport_world_size;
+  ftjson::Array tids;
+  for (const auto& id : transport_replica_ids) tids.push_back(id);
+  o["transport_replica_ids"] = ftjson::Value(std::move(tids));
   o["replica_rank"] = replica_rank;
   o["replica_world_size"] = replica_world_size;
   o["heal"] = heal;
@@ -206,12 +218,28 @@ QuorumResults compute_quorum_results(const std::string& replica_id,
                              " not participating in returned quorum");
   }
 
-  int64_t max_step = 0;
-  for (const auto& p : participants) max_step = std::max(max_step, p.step);
-
-  // Index list of the up-to-date ("max step") cohort.
-  std::vector<size_t> max_indices;
+  // Observers (data_plane=false) are invisible to all step/recovery
+  // logic: they are not electable as primary/donor, never recovery
+  // destinations, don't define max_step, and are not counted in the
+  // participating cohort — they join only the quorum and the commit
+  // barrier. (A degenerate all-observer quorum falls back to treating
+  // everyone as data-plane so the kernel stays total.)
+  std::vector<size_t> dp_indices;
   for (size_t i = 0; i < participants.size(); i++) {
+    if (participants[i].data_plane) dp_indices.push_back(i);
+  }
+  if (dp_indices.empty()) {
+    for (size_t i = 0; i < participants.size(); i++) dp_indices.push_back(i);
+  }
+
+  int64_t max_step = 0;
+  for (size_t i : dp_indices) {
+    max_step = std::max(max_step, participants[i].step);
+  }
+
+  // Index list of the up-to-date ("max step") data-plane cohort.
+  std::vector<size_t> max_indices;
+  for (size_t i : dp_indices) {
     if (participants[i].step == max_step) max_indices.push_back(i);
   }
 
@@ -230,9 +258,11 @@ QuorumResults compute_quorum_results(const std::string& replica_id,
 
   // Recovering replicas: behind max_step, or (step 0 bootstrap) everyone but
   // the primary so that all replicas sync identical initial state.
+  // Observers are excluded: assigning one as a perpetual recover_dst would
+  // make every donor restage a full checkpoint each quorum round.
   std::vector<size_t> recover_dst;
   std::set<size_t> recover_dst_set;
-  for (size_t i = 0; i < participants.size(); i++) {
+  for (size_t i : dp_indices) {
     if (participants[i].step != max_step ||
         (max_step == 0 && primary.replica_id != participants[i].replica_id)) {
       recover_dst.push_back(i);
@@ -240,7 +270,7 @@ QuorumResults compute_quorum_results(const std::string& replica_id,
     }
   }
   std::vector<size_t> up_to_date;
-  for (size_t i = 0; i < participants.size(); i++) {
+  for (size_t i : dp_indices) {
     if (!recover_dst_set.count(i)) up_to_date.push_back(i);
   }
 
@@ -272,6 +302,21 @@ QuorumResults compute_quorum_results(const std::string& replica_id,
   out.max_step = max_step;
   out.max_rank = max_rank;
   out.max_world_size = static_cast<int64_t>(max_indices.size());
+  for (size_t mi : max_indices) {
+    out.max_replica_ids.push_back(participants[mi].replica_id);
+  }
+  // Data-plane membership: everyone who did not opt out, in sorted order
+  // (so all members derive identical transport ranks).
+  for (const auto& p : participants) {
+    if (!p.data_plane) continue;
+    if (p.replica_id == replica_id) {
+      out.transport_rank =
+          static_cast<int64_t>(out.transport_replica_ids.size());
+    }
+    out.transport_replica_ids.push_back(p.replica_id);
+  }
+  out.transport_world_size =
+      static_cast<int64_t>(out.transport_replica_ids.size());
   out.replica_rank = replica_rank;
   out.replica_world_size = static_cast<int64_t>(participants.size());
   return out;

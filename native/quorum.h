@@ -25,6 +25,11 @@ struct Member {
   int64_t step = 0;
   uint64_t world_size = 1;
   bool shrink_only = false;
+  // false = observer replica: joins the quorum and the commit barrier but
+  // opts out of the gradient data plane (e.g. monitoring probes, bench
+  // echo replicas on a host that cannot absorb the wire). Data-plane
+  // members must never wait on an observer's transport.
+  bool data_plane = true;
 
   ftjson::Value to_json() const;
   static Member from_json(const ftjson::Value& v);
@@ -85,6 +90,16 @@ struct QuorumResults {
   int64_t max_step = 0;
   std::optional<int64_t> max_rank;
   int64_t max_world_size = 0;
+  // Sorted replica_ids of the max-step cohort (diagnostics/labeling).
+  std::vector<std::string> max_replica_ids;
+  // Data-plane transport membership: the quorum participants that did not
+  // opt out of the gradient wire (Member.data_plane). Healing replicas
+  // stay members (they must RECEIVE the cohort average in their heal
+  // step); observers are excluded so the wire never waits on them.
+  // transport_rank is nullopt when this replica itself opted out.
+  std::optional<int64_t> transport_rank;
+  int64_t transport_world_size = 0;
+  std::vector<std::string> transport_replica_ids;
   int64_t replica_rank = 0;
   int64_t replica_world_size = 0;
   bool heal = false;

@@ -72,6 +72,10 @@ def quorum_result(
     max_step=0,
     max_rank=0,
     max_world_size=2,
+    max_replica_ids=(),
+    transport_rank=None,
+    transport_world_size=0,
+    transport_replica_ids=(),
     heal=False,
 ):
     return QuorumResult(
@@ -85,6 +89,10 @@ def quorum_result(
         max_step=max_step,
         max_rank=max_rank,
         max_world_size=max_world_size,
+        max_replica_ids=list(max_replica_ids),
+        transport_rank=transport_rank,
+        transport_world_size=transport_world_size,
+        transport_replica_ids=list(transport_replica_ids),
         heal=heal,
     )
 
@@ -147,7 +155,75 @@ def test_happy_path_step_commit(store) -> None:
     assert manager.current_step() == 1
     assert manager.batches_committed() == 2
     assert len(comm.configure_calls) == 1
-    assert comm.configure_calls[0] == ("store/torchft/1/0", 0, 2)
+    # no cohort info in the quorum result -> full-membership transport
+    assert comm.configure_calls[0] == ("store/torchft/1/all/0", 0, 2)
+    manager.shutdown(wait=False)
+
+
+def test_transport_scoped_to_data_plane_members(store) -> None:
+    # The wire spans the quorum's data-plane members (transport_* fields),
+    # not the full membership: an observer in the quorum must not widen
+    # the transport world.
+    manager, client, comm, _ = make_manager(store)
+    client.quorum.return_value = quorum_result(
+        replica_rank=0, replica_world_size=3,
+        max_step=5, max_rank=0, max_world_size=2,
+        transport_rank=0, transport_world_size=2,
+        transport_replica_ids=("a", "b"),  # "c" is an observer
+    )
+    manager.start_quorum()
+    manager.wait_quorum()
+    assert len(comm.configure_calls) == 1
+    prefix, rank, world = comm.configure_calls[0]
+    assert (rank, world) == (0, 2)  # wire rank/world, not (0, 3)
+    assert "/observer/" not in prefix
+
+    # same quorum_id, same wire membership -> no reconfigure
+    manager.start_quorum()
+    manager.wait_quorum()
+    assert len(comm.configure_calls) == 1
+
+    # same quorum_id, wire membership changed (an observer flipped to
+    # data-plane) -> the transport reconfigures even though quorum
+    # membership (quorum_id) did not change
+    client.quorum.return_value = quorum_result(
+        replica_rank=0, replica_world_size=3,
+        max_step=5, max_rank=0, max_world_size=3,
+        transport_rank=0, transport_world_size=3,
+        transport_replica_ids=("a", "b", "c"),
+    )
+    manager.start_quorum()
+    manager.wait_quorum()
+    assert len(comm.configure_calls) == 2
+    prefix2, rank2, world2 = comm.configure_calls[1]
+    assert (rank2, world2) == (0, 3)
+    assert prefix2 != prefix
+    manager.shutdown(wait=False)
+
+
+def test_observer_gets_solo_transport_and_never_participates(store) -> None:
+    # An observer (Manager(data_plane=False)) configures a private
+    # 1-member transport and reports itself non-participating even when
+    # its step matches the cohort: peers cannot receive anything from a
+    # replica that is off the wire.
+    manager, client, comm, _ = make_manager(store, data_plane=False)
+    client.quorum.return_value = quorum_result(
+        replica_rank=2, replica_world_size=3,
+        max_step=0, max_rank=2, max_world_size=3,  # in cohort by step...
+        transport_rank=None, transport_world_size=2,
+        transport_replica_ids=("a", "b"),  # ...but off the wire
+    )
+    manager.start_quorum(allow_heal=False)
+    manager.wait_quorum()
+    assert len(comm.configure_calls) == 1
+    prefix, rank, world = comm.configure_calls[0]
+    assert (rank, world) == (0, 1)
+    assert "/observer/" in prefix
+    assert not manager.is_participating()
+
+    # allreduce contributes zeros without touching the cohort wire
+    fut = manager.allreduce_arrays([np.full(2, 5.0, np.float32)]).future()
+    np.testing.assert_allclose(fut.result(timeout=5)[0], np.zeros(2))
     manager.shutdown(wait=False)
 
 

@@ -273,3 +273,74 @@ def test_compute_results_missing_replica_raises() -> None:
     parts = [member("replica_0", step=0)]
     with pytest.raises(RuntimeError, match="not participating"):
         compute_quorum_results("ghost", 0, parts)
+
+
+def test_transport_membership_excludes_observers() -> None:
+    # Member.data_plane=false (observer) replicas join the quorum but are
+    # excluded from the data-plane transport fields; data-plane members
+    # get contiguous transport ranks in sorted-replica order.
+    parts = [
+        member("a", step=5),
+        {**member("b", step=0), "data_plane": False},  # observer, behind
+        member("c", step=5),
+    ]
+    res_a = compute_quorum_results("a", 0, parts)
+    assert res_a["transport_replica_ids"] == ["a", "c"]
+    assert res_a["transport_rank"] == 0
+    assert res_a["transport_world_size"] == 2
+    # cohort (step-based) info is independent of data-plane membership
+    assert res_a["max_replica_ids"] == ["a", "c"]
+
+    res_c = compute_quorum_results("c", 0, parts)
+    assert res_c["transport_rank"] == 1
+
+    # the observer itself: in the quorum, off the wire
+    res_b = compute_quorum_results("b", 0, parts)
+    assert res_b["transport_rank"] is None
+    assert res_b["transport_world_size"] == 2
+    assert res_b["replica_world_size"] == 3
+
+
+def test_transport_membership_includes_healing_members() -> None:
+    # A behind (healing) data-plane replica stays on the wire: it must
+    # receive the cohort average in its heal step.
+    parts = [member("a", step=9), member("b", step=2)]
+    res_b = compute_quorum_results("b", 0, parts)
+    assert res_b["heal"] is True
+    assert res_b["transport_replica_ids"] == ["a", "b"]
+    assert res_b["transport_rank"] == 1
+    assert res_b["max_replica_ids"] == ["a"]
+
+
+def test_observers_invisible_to_step_and_recovery_logic() -> None:
+    # Observers must not: define max_step, be elected bootstrap primary /
+    # donor, appear in recover_dst, or count in the participating cohort.
+    # Bootstrap (everyone at step 0, observer sorts first):
+    parts0 = [
+        {**member("_obs", step=0), "data_plane": False},
+        member("a", step=0),
+        member("b", step=0),
+    ]
+    res_a = compute_quorum_results("a", 0, parts0)
+    # primary is a data-plane member ("a", first dp in sorted order), so
+    # recover_dst is the OTHER dp member only — never the observer
+    assert res_a["recover_dst_ranks"] == [2]  # "b"'s replica_rank
+    assert res_a["max_world_size"] == 2
+    assert res_a["store_address"] == "store_addr_a"
+
+    # An observer with a bogus ahead step must not drag max_step up:
+    parts_ahead = [
+        {**member("obs", step=99), "data_plane": False},
+        member("a", step=5),
+        member("b", step=5),
+    ]
+    res = compute_quorum_results("a", 0, parts_ahead)
+    assert res["max_step"] == 5
+    assert res["max_replica_ids"] == ["a", "b"]
+    assert res["heal"] is False
+
+    # The observer's own view: never healing, never participating.
+    res_obs = compute_quorum_results("obs", 0, parts_ahead)
+    assert res_obs["heal"] is False
+    assert res_obs["max_rank"] is None
+    assert res_obs["transport_rank"] is None

@@ -58,6 +58,14 @@ class QuorumResult:
     max_step: int = 0
     max_rank: Optional[int] = None
     max_world_size: int = 1
+    # Sorted replica_ids of the max-step cohort (diagnostics/labeling).
+    max_replica_ids: List[str] = field(default_factory=list)
+    # Data-plane transport membership: quorum participants that did not
+    # opt out of the gradient wire (observer replicas are excluded).
+    # transport_rank is None when this replica itself opted out.
+    transport_rank: Optional[int] = None
+    transport_world_size: int = 0
+    transport_replica_ids: List[str] = field(default_factory=list)
     heal: bool = False
 
     @staticmethod
@@ -74,6 +82,12 @@ class QuorumResult:
             max_step=d["max_step"],
             max_rank=d.get("max_rank"),
             max_world_size=d["max_world_size"],
+            max_replica_ids=list(d.get("max_replica_ids") or []),
+            transport_rank=d.get("transport_rank"),
+            transport_world_size=d.get("transport_world_size", 0),
+            transport_replica_ids=list(
+                d.get("transport_replica_ids") or []
+            ),
             heal=d["heal"],
         )
 
@@ -212,6 +226,7 @@ class ManagerClient:
         checkpoint_metadata: str,
         shrink_only: bool,
         timeout: "float | timedelta",
+        data_plane: bool = True,
     ) -> QuorumResult:
         err = ctypes.c_char_p()
         ptr = get_lib().ft_manager_client_quorum(
@@ -220,6 +235,7 @@ class ManagerClient:
             step,
             checkpoint_metadata.encode(),
             1 if shrink_only else 0,
+            1 if data_plane else 0,
             _ms(timeout),
             ctypes.byref(err),
         )

@@ -15,6 +15,10 @@ class QuorumResult:
     max_step: int
     max_rank: Optional[int]
     max_world_size: int
+    max_replica_ids: List[str]
+    transport_rank: Optional[int]
+    transport_world_size: int
+    transport_replica_ids: List[str]
     heal: bool
 
 class ManagerClient:

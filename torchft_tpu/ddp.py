@@ -123,10 +123,11 @@ class DistributedDataParallel:
 
         from torchft_tpu.futures import completed_future
 
-        # Solo-quorum fast path: with no peer replica the average is an
+        # Solo-wire fast path: with no data-plane peer (observers don't
+        # count — they neither contribute nor receive) the average is an
         # identity; skip the device→host fetch and the transport entirely
-        # (see Manager.replica_world_size). The quorum still runs — it is
-        # what detects rejoining peers.
+        # (see Manager.transport_world_size). The quorum still runs — it
+        # is what detects rejoining peers.
         try:
             self._manager.wait_quorum()
         except Exception as e:  # noqa: BLE001
@@ -137,7 +138,7 @@ class DistributedDataParallel:
             return completed_future(grads)
         if (
             self._manager.errored() is None
-            and self._manager.replica_world_size() == 1
+            and self._manager.transport_world_size() == 1
             and self._manager.is_participating()
         ):
             return completed_future(grads)

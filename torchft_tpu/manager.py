@@ -68,6 +68,15 @@ LIGHTHOUSE_ENV: str = "TORCHFT_TPU_LIGHTHOUSE"
 __all__ = ["Manager", "WorldSizeMode"]
 
 
+def _cohort_fingerprint(replica_ids: "Sequence[str]") -> str:
+    """Short stable digest of a (sorted) replica_id list, used in the
+    transport rendezvous prefix so all wire members key the same transport
+    incarnation and reconfigure exactly when membership changes."""
+    import hashlib
+
+    return hashlib.sha1("\x00".join(replica_ids).encode()).hexdigest()[:12]
+
+
 def _seconds(t: "float | timedelta") -> float:
     return t.total_seconds() if isinstance(t, timedelta) else float(t)
 
@@ -115,11 +124,18 @@ class Manager:
         hostname: Optional[str] = None,
         heartbeat_interval: "float | timedelta" = 0.1,
         checkpoint_transport: Optional[CheckpointTransport] = None,
+        data_plane: bool = True,
     ) -> None:
         self._load_state_dict = load_state_dict
         self._user_state_dict = state_dict
         self._pending_state_dict: Optional[Dict[str, Any]] = None
         self._use_async_quorum = use_async_quorum
+        # False = observer replica: joins the quorum and commit barrier
+        # but opts out of the gradient data plane — peers' transports
+        # never include (or wait on) this replica. Use for monitoring
+        # probes or load generators; an observer should also run with
+        # allow_heal=False (it is permanently behind the cohort).
+        self._data_plane = data_plane
         self._timeout = _seconds(timeout)
         self._quorum_timeout = _seconds(quorum_timeout)
         self._connect_timeout = _seconds(connect_timeout)
@@ -187,7 +203,12 @@ class Manager:
         self._logger = _ManagerLogger(self, replica_id, self._rank)
 
         self._step = 0
-        self._quorum_id = -1
+        # (quorum_id, wire-membership fingerprint, in_transport) of the
+        # last successful comm.configure — the transport reconfigures
+        # exactly when this changes (quorum membership change, data-plane
+        # opt-out set change).
+        self._transport_key: "Optional[tuple]" = None
+        self._transport_world_size = 1
         self._errored: Optional[Exception] = None
         self._errored_lock = threading.Lock()
         self._healing = False
@@ -398,19 +419,34 @@ class Manager:
             checkpoint_metadata=self._checkpoint_transport.metadata(),
             shrink_only=shrink_only,
             timeout=quorum_timeout,
+            data_plane=self._data_plane,
         )
 
     def _finish_quorum(self, quorum, allow_heal: bool) -> None:
         # Async quorum: only the up-to-date (max-step) cohort participates —
         # healing replicas contribute zeros this step. Sync quorum (or
-        # allow_heal=False): everyone in the quorum participates
-        # (ref manager.py:449-456).
-        self._participating_rank, self._participating_world_size = (
-            (quorum.max_rank, quorum.max_world_size)
-            if self._use_async_quorum or not allow_heal
-            else (quorum.replica_rank, quorum.replica_world_size)
-        )
+        # allow_heal=False): everyone ON THE WIRE participates
+        # (ref manager.py:449-456 semantics, minus observers: the sync
+        # count must use the data-plane membership, not the full quorum,
+        # or an off-wire observer would inflate 1/num_participants and
+        # silently under-scale every averaged gradient).
+        if self._use_async_quorum or not allow_heal:
+            self._participating_rank = quorum.max_rank
+            self._participating_world_size = quorum.max_world_size
+        elif quorum.transport_replica_ids:
+            self._participating_rank = quorum.transport_rank
+            self._participating_world_size = quorum.transport_world_size
+        else:  # old control plane without data-plane info
+            self._participating_rank = quorum.replica_rank
+            self._participating_world_size = quorum.replica_world_size
         self._replica_world_size = quorum.replica_world_size
+
+        if not self._data_plane:
+            # Observers never contribute gradients, no matter their step:
+            # peers cannot receive anything from a replica that is off the
+            # wire, so counting ourselves participating would corrupt OUR
+            # OWN 1/num_participants scaling.
+            self._participating_rank = None
 
         if self._world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
             # Spares contribute zero gradients (ref manager.py:460-468).
@@ -423,25 +459,58 @@ class Manager:
             ):
                 self._participating_rank = None
 
-        if quorum.quorum_id != self._quorum_id:
-            store_prefixed_addr = (
-                f"{quorum.store_address}/torchft/{quorum.quorum_id}/{self._rank}"
-            )
+        # --- data-plane (re)configuration ---------------------------------
+        # The gradient wire spans the quorum members that did not opt out
+        # of the data plane (observer replicas, Manager(data_plane=False)).
+        # Healing replicas STAY members: in the heal step they receive the
+        # cohort's averaged gradients and apply them on top of the fetched
+        # state, which is what makes recovery bitwise-exact (ref
+        # manager.py:492-543 order: load state, then optimizer step with
+        # the received average). Observers join the quorum and the commit
+        # barrier but the wire never waits on them — the reference cannot
+        # express this (a c10d communicator must span every rank of the
+        # group, ref process_group.py:250-300); a per-quorum TCP transport
+        # can.
+        if quorum.transport_replica_ids:
+            in_transport = quorum.transport_rank is not None
+            t_rank = quorum.transport_rank if in_transport else 0
+            t_world = quorum.transport_world_size if in_transport else 1
+            fingerprint = _cohort_fingerprint(quorum.transport_replica_ids)
+        else:
+            # old control plane without transport info: full membership
+            in_transport = True
+            t_rank, t_world = quorum.replica_rank, quorum.replica_world_size
+            fingerprint = "all"
+        self._transport_world_size = t_world if in_transport else 1
+        transport_key = (quorum.quorum_id, fingerprint, in_transport)
+        if transport_key != self._transport_key:
+            if in_transport:
+                store_prefixed_addr = (
+                    f"{quorum.store_address}/torchft/{quorum.quorum_id}"
+                    f"/{fingerprint}/{self._rank}"
+                )
+            else:
+                # Observer: a private 1-member transport (no peers,
+                # trivially healthy) keeps the comm state machine uniform;
+                # the replica_id in the prefix avoids rendezvous
+                # collisions among several observers.
+                store_prefixed_addr = (
+                    f"{quorum.store_address}/torchft/{quorum.quorum_id}"
+                    f"/{fingerprint}/observer/{self._replica_id}/{self._rank}"
+                )
             self._logger.info(
                 f"reconfiguring for quorum_id={quorum.quorum_id} "
+                f"wire={fingerprint} in_transport={in_transport} "
                 f"store={store_prefixed_addr}"
             )
             try:
-                self._comm.configure(
-                    store_prefixed_addr, quorum.replica_rank,
-                    quorum.replica_world_size,
-                )
-                self._quorum_id = quorum.quorum_id
+                self._comm.configure(store_prefixed_addr, t_rank, t_world)
+                self._transport_key = transport_key
             except Exception as e:  # noqa: BLE001
                 # A peer that died between quorum announcement and transport
                 # rendezvous lands here. Latch: this step is discarded and
-                # the UNCHANGED _quorum_id forces reconfiguration on the
-                # next quorum (hardening over ref manager.py:475 TODO).
+                # the UNCHANGED _transport_key forces reconfiguration on
+                # the next quorum (hardening over ref manager.py:475 TODO).
                 self._logger.exception(f"comm configure failed: {e}")
                 self.report_error(e)
 
@@ -621,12 +690,19 @@ class Manager:
         return self._did_heal
 
     def replica_world_size(self) -> int:
-        """Total replicas in the current quorum (participating + healing).
-        When this is 1 there is no peer to reduce with, so gradient
-        averaging is an identity — wrappers use this to skip the
-        device→host→DCN round trip entirely (a fast path the reference
-        lacks: its single-replica jobs still run a loopback PG allreduce)."""
+        """Total replicas in the current quorum (participating + healing
+        + observers)."""
         return self._replica_world_size
+
+    def transport_world_size(self) -> int:
+        """Members of the gradient wire for the current quorum (data-plane
+        replicas: participants + healing receivers, minus observers).
+        When this is 1 there is no peer to reduce with OR to feed, so
+        gradient averaging is an identity — wrappers use this to skip the
+        device→host→DCN round trip entirely (a fast path the reference
+        lacks: its single-replica jobs still run a loopback PG
+        allreduce)."""
+        return self._transport_world_size
 
     def participating_rank(self) -> Optional[int]:
         return self._participating_rank
