@@ -217,3 +217,34 @@ def test_train_llama_ring_example_runs() -> None:
         assert "step 3" in proc.stdout, proc.stdout
     finally:
         lh.shutdown()
+
+
+def test_train_moe_example_runs() -> None:
+    # MoE transformer (expert-parallel GShard FFN on an ``expert`` mesh
+    # axis) x FT manager loop, end-to-end as a real subprocess — the
+    # apps-level seal on the expert-parallel composition.
+    import os
+
+    from torchft_tpu.control import Lighthouse
+
+    lh = Lighthouse(min_replicas=1, join_timeout_ms=200)
+    env = dict(os.environ)
+    env.update(
+        TORCHFT_TPU_LIGHTHOUSE=lh.address(),
+        TOTAL_STEPS="3",
+        REPLICA_GROUP_ID="0",
+        LOGLEVEL="ERROR",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+    )
+    env.pop("PYTHONPATH", None)  # drop the axon sitecustomize
+    try:
+        proc = subprocess.run(
+            [sys.executable, "examples/train_moe.py"],
+            env=env, capture_output=True, text=True, timeout=180,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "step 3" in proc.stdout, proc.stdout
+    finally:
+        lh.shutdown()

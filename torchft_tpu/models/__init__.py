@@ -11,6 +11,13 @@ from torchft_tpu.models.llama import (  # noqa: F401
     llama_init_params,
     llama_loss_fn,
 )
+from torchft_tpu.models.moe_transformer import (  # noqa: F401
+    MOE_CONFIGS,
+    MoETransformerConfig,
+    make_moe_train_step,
+    moe_init_params,
+    moe_transformer_loss_fn,
+)
 from torchft_tpu.models.transformer import (  # noqa: F401
     CONFIGS,
     TransformerConfig,

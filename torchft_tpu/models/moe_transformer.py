@@ -1,0 +1,203 @@
+"""Mixture-of-Experts decoder-only transformer (GShard-style).
+
+Composes the expert-parallel MoE feed-forward block (parallel/moe.py) into
+the flagship GPT stack (models/transformer.py): every ``moe_every``-th
+layer replaces its dense MLP with a capacity-based top-2 MoE layer whose
+expert weights shard on the ``expert`` mesh axis. The reference framework
+has neither a model zoo nor any MoE machinery (SURVEY.md §2c: EP absent);
+this family makes expert parallelism a trainable end-to-end model rather
+than a standalone layer.
+
+TPU-first choices mirror the dense flagship: bf16 activations, f32 params,
+static shapes (capacity bounds routing), per-layer remat, attention
+pluggable (local / ring). Sharding: ``moe_rules() + tp_rules_gpt()`` lets
+one rule list shard attention on ``tensor`` and experts on ``expert``
+simultaneously (tested in tests/test_moe_model.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchft_tpu.models.transformer import (
+    TransformerConfig,
+    _attn_sublayer,
+    _block,
+    _embed,
+    _layer_norm,
+    _local_causal_attention,
+    ce_from_hidden,
+    init_params as _dense_init_params,
+)
+from torchft_tpu.parallel.moe import (
+    MoEConfig,
+    init_moe_params,
+    moe_forward,
+)
+
+__all__ = [
+    "MoETransformerConfig",
+    "MOE_CONFIGS",
+    "moe_init_params",
+    "moe_transformer_loss_fn",
+    "make_moe_train_step",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoETransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 1024
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    moe_every: int = 2          # layer i uses MoE iff i % moe_every == 1
+    aux_loss_weight: float = 1e-2
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    xent_chunks: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    def is_moe_layer(self, i: int) -> bool:
+        # GShard places MoE on odd layers (every other); moe_every=1 makes
+        # every layer MoE
+        return i % self.moe_every == self.moe_every - 1
+
+    def dense_cfg(self) -> TransformerConfig:
+        """The dense skeleton this family shares params/blocks with."""
+        return TransformerConfig(
+            vocab_size=self.vocab_size, d_model=self.d_model,
+            n_layers=self.n_layers, n_heads=self.n_heads, d_ff=self.d_ff,
+            max_seq_len=self.max_seq_len, dtype=self.dtype,
+            param_dtype=self.param_dtype, remat=self.remat,
+            xent_chunks=self.xent_chunks,
+        )
+
+    def moe_cfg(self) -> MoEConfig:
+        return MoEConfig(
+            d_model=self.d_model, d_ff=self.d_ff,
+            num_experts=self.num_experts,
+            capacity_factor=self.capacity_factor, dtype=self.dtype,
+        )
+
+
+MOE_CONFIGS: Dict[str, MoETransformerConfig] = {
+    "moe-tiny": MoETransformerConfig(
+        vocab_size=512, d_model=64, n_layers=2, n_heads=4, d_ff=256,
+        max_seq_len=128, num_experts=4, remat=False,
+    ),
+    # 125m backbone, 8 experts on alternating layers — the EP bench shape
+    "moe-8x125m": MoETransformerConfig(
+        vocab_size=32768, d_model=768, n_layers=12, n_heads=12, d_ff=3072,
+        max_seq_len=1024, num_experts=8, xent_chunks=8,
+    ),
+}
+
+
+def moe_init_params(cfg: MoETransformerConfig, key) -> Dict:
+    """Dense skeleton params with each MoE layer's ``mlp`` replaced by a
+    ``moe`` subtree (paths match moe_rules(): layers_i/moe/gate/kernel,
+    layers_i/moe/experts/{up,down})."""
+    kd, km = jax.random.split(key)
+    params = _dense_init_params(cfg.dense_cfg(), kd)
+    moe_keys = jax.random.split(km, cfg.n_layers)
+    for i in range(cfg.n_layers):
+        if cfg.is_moe_layer(i):
+            layer = dict(params[f"layers_{i}"])
+            del layer["mlp"]
+            layer["moe"] = init_moe_params(moe_keys[i], cfg.moe_cfg())
+            # cast expert/gate params to the family's param dtype
+            layer["moe"] = jax.tree_util.tree_map(
+                lambda a: a.astype(cfg.param_dtype), layer["moe"]
+            )
+            params[f"layers_{i}"] = layer
+    return params
+
+
+def _moe_block(cfg: MoETransformerConfig, layer: Dict, x, *, attn_fn):
+    """Attention sublayer identical to the dense block; FFN sublayer is the
+    MoE dispatch/combine. Returns (x, aux_loss)."""
+    x = _attn_sublayer(cfg, layer, x, attn_fn=attn_fn)
+
+    h = _layer_norm(x, layer["ln_2"]["scale"], layer["ln_2"]["bias"])
+    y, aux = moe_forward(cfg.moe_cfg(), layer["moe"], h)
+    # over-capacity tokens produce y == 0 there: residual passes through
+    return x + y, aux
+
+
+def moe_forward_hidden(
+    cfg: MoETransformerConfig,
+    params: Dict,
+    tokens,
+    attn_fn: Optional[Callable] = None,
+) -> Tuple[Any, Any]:
+    """tokens [B,S] -> (hidden [B,S,D] post-final-norm, total aux loss)."""
+    if attn_fn is None:
+        attn_fn = _local_causal_attention
+    dense = cfg.dense_cfg()
+    x = _embed(cfg, params, tokens)
+
+    dense_block = functools.partial(_block, dense, attn_fn=attn_fn)
+    moe_block = functools.partial(_moe_block, cfg, attn_fn=attn_fn)
+    if cfg.remat:
+        dense_block = jax.checkpoint(dense_block)
+        moe_block = jax.checkpoint(moe_block)
+
+    aux_total = jnp.zeros((), jnp.float32)
+    for i in range(cfg.n_layers):
+        layer = params[f"layers_{i}"]
+        if cfg.is_moe_layer(i):
+            x, aux = moe_block(layer, x)
+            aux_total = aux_total + aux.astype(jnp.float32)
+        else:
+            x = dense_block(layer, x)
+
+    h = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    return h, aux_total
+
+
+def moe_transformer_loss_fn(
+    cfg: MoETransformerConfig, params, tokens, targets,
+    attn_fn: Optional[Callable] = None,
+):
+    """Mean next-token CE + aux_loss_weight * load-balancing loss."""
+    h, aux = moe_forward_hidden(cfg, params, tokens, attn_fn)
+    ce = ce_from_hidden(
+        h, params["lm_head"]["kernel"], targets, cfg.xent_chunks
+    )
+    return ce + cfg.aux_loss_weight * aux
+
+
+def make_moe_train_step(cfg: MoETransformerConfig, tx,
+                        attn_fn: Optional[Callable] = None,
+                        donate: bool = True):
+    """Jitted (params, opt_state, tokens, targets) -> (params, opt_state,
+    loss). Like the dense flagship's step, the replica dimension does not
+    exist here; run it under a ``shard_map``/pjit mesh carrying an
+    ``expert`` axis for EP (see tests/test_moe_model.py)."""
+    import optax
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(
+            lambda p: moe_transformer_loss_fn(cfg, p, tokens, targets,
+                                              attn_fn)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)

@@ -136,7 +136,10 @@ def _local_causal_attention(q, k, v):
     return causal_attention(q, k, v)
 
 
-def _block(cfg: TransformerConfig, layer: Dict, x, *, attn_fn):
+def _attn_sublayer(cfg, layer: Dict, x, *, attn_fn):
+    """ln_1 + multi-head causal attention + residual. ``cfg`` is duck-typed
+    (needs dtype/n_heads/head_dim/d_model) so MoE and other families reuse
+    the exact dense attention path."""
     dt = cfg.dtype
     h = _layer_norm(x, layer["ln_1"]["scale"], layer["ln_1"]["bias"])
     B, S, _ = h.shape
@@ -150,13 +153,44 @@ def _block(cfg: TransformerConfig, layer: Dict, x, *, attn_fn):
         B, S, cfg.n_heads, cfg.head_dim
     )
     a = attn_fn(q, k, v).reshape(B, S, cfg.d_model)
-    x = x + a @ layer["attn"]["o_proj"]["kernel"].astype(dt)
+    return x + a @ layer["attn"]["o_proj"]["kernel"].astype(dt)
+
+
+def _block(cfg: TransformerConfig, layer: Dict, x, *, attn_fn):
+    dt = cfg.dtype
+    x = _attn_sublayer(cfg, layer, x, attn_fn=attn_fn)
 
     h = _layer_norm(x, layer["ln_2"]["scale"], layer["ln_2"]["bias"])
     h = h @ layer["mlp"]["up_proj"]["kernel"].astype(dt)
     h = jax.nn.gelu(h)
     x = x + h @ layer["mlp"]["down_proj"]["kernel"].astype(dt)
     return x
+
+
+def _embed(cfg, params: Dict, tokens):
+    """Token + learned-position embeddings in the compute dtype. ``cfg`` is
+    duck-typed (needs dtype) so other families share the preamble."""
+    dt = cfg.dtype
+    S = tokens.shape[1]
+    x = params["wte"]["embedding"].astype(dt)[tokens]
+    return x + params["wpe"]["embedding"].astype(dt)[jnp.arange(S)][None, :, :]
+
+
+def ce_from_hidden(h, lm_head_kernel, targets, xent_chunks: int = 0):
+    """Mean next-token cross entropy from final-norm hidden states.
+    ``xent_chunks`` > 0 routes through ops/xent.py's online-logsumexp scan
+    so the [B, S, V] logits tensor is never materialized (exact up to fp
+    reassociation); 0 = dense log_softmax. Assumes a replicated lm head —
+    under TP (vocab-sharded head) use
+    ops/xent.py make_vocab_parallel_cross_entropy instead."""
+    if xent_chunks > 0:
+        from torchft_tpu.ops.xent import hidden_cross_entropy
+
+        return hidden_cross_entropy(h, lm_head_kernel, targets, xent_chunks)
+    logits = h.astype(jnp.float32) @ lm_head_kernel.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
 
 
 def forward_hidden(
@@ -169,10 +203,7 @@ def forward_hidden(
     (pre-lm-head), so losses can fuse the vocab projection."""
     if attn_fn is None:
         attn_fn = _local_causal_attention
-    B, S = tokens.shape
-    dt = cfg.dtype
-    x = params["wte"]["embedding"].astype(dt)[tokens]
-    x = x + params["wpe"]["embedding"].astype(dt)[jnp.arange(S)][None, :, :]
+    x = _embed(cfg, params, tokens)
 
     block = functools.partial(_block, cfg, attn_fn=attn_fn)
     if cfg.remat:
@@ -199,23 +230,13 @@ def forward(
 
 def loss_fn(cfg: TransformerConfig, params, tokens, targets,
             attn_fn: Optional[Callable] = None):
-    """Mean next-token cross entropy. With cfg.xent_chunks > 0 the
-    [B, S, V] logits tensor is never materialized (ops/xent.py online
-    logsumexp; exact up to fp reassociation). The chunked path assumes a
-    replicated lm head — under tensor parallelism (vocab-sharded head,
-    tp_rules_gpt) use ops/xent.py's make_vocab_parallel_cross_entropy as
-    the loss instead (see __graft_entry__.dryrun_multichip §1b)."""
-    if cfg.xent_chunks > 0:
-        from torchft_tpu.ops.xent import hidden_cross_entropy
-
-        h = forward_hidden(cfg, params, tokens, attn_fn)
-        return hidden_cross_entropy(
-            h, params["lm_head"]["kernel"], targets, cfg.xent_chunks
-        )
-    logits = forward(cfg, params, tokens, attn_fn)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    """Mean next-token cross entropy (see ce_from_hidden for the
+    chunked-vs-dense and TP caveats; __graft_entry__.dryrun_multichip §1b
+    shows the vocab-parallel TP loss)."""
+    h = forward_hidden(cfg, params, tokens, attn_fn)
+    return ce_from_hidden(
+        h, params["lm_head"]["kernel"], targets, cfg.xent_chunks
+    )
 
 
 def make_train_step(cfg: TransformerConfig, tx,

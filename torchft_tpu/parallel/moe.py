@@ -54,14 +54,15 @@ def init_moe_params(key, cfg: MoEConfig) -> Dict:
 
 
 def moe_rules():
-    """TP-style path rules sharding expert weights on the ``expert`` axis
-    (feed to parallel.sharding.make_sharding_fn via tensor_axis="expert",
-    or merge with tp_rules_gpt for combined TP+EP)."""
+    """Path rules sharding expert weights on the ``expert`` axis. The rules
+    carry their mesh axis explicitly (3-tuples, parallel.sharding.TpRule),
+    so they compose with tp_rules_gpt() in ONE shard_pytree pass: attention
+    lands on "tensor", experts on "expert" (tests/test_moe_model.py)."""
     return [
-        (r".*experts/(up|down)", 0),   # expert dim
-        (r".*gate/kernel", None),      # router replicated (anchored so a
-                                       # transformer's gate_proj still gets
-                                       # its TP rule when rule lists merge)
+        (r".*experts/(up|down)", 0, "expert"),   # expert dim
+        (r".*gate/kernel", None, "expert"),      # router replicated
+        # (gate pattern is anchored so a transformer's gate_proj still gets
+        # its TP rule when rule lists merge)
     ]
 
 

@@ -21,7 +21,7 @@ Everything here produces `NamedSharding`s to feed `jax.device_put` /
 from __future__ import annotations
 
 import re
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 __all__ = [
     "fsdp_sharding",
@@ -78,8 +78,15 @@ def fsdp_sharding(mesh, shape: Sequence[int], dtype=None,
 # --- Tensor parallel rules ---------------------------------------------------
 
 # Each rule: (path regex, dim to shard on the tensor axis) where dim indexes
-# the weight's shape; None dim = replicate.
-TpRule = Tuple[str, Optional[int]]
+# the weight's shape; None dim = replicate. A rule may carry an explicit
+# third element naming the mesh axis it shards on (e.g. "expert"), letting
+# one rule list drive several model-parallel axes at once — tp_rules_gpt()
+# + moe_rules() shards attention on "tensor" and experts on "expert" in a
+# single shard_pytree pass (tests/test_moe_model.py).
+TpRule = Union[
+    Tuple[str, Optional[int]],            # axis = make_sharding_fn's default
+    Tuple[str, Optional[int], str],       # explicit mesh axis
+]
 
 
 def tp_rules_gpt() -> List[TpRule]:
@@ -125,7 +132,6 @@ def make_sharding_fn(
     sharding of the remaining dims (the HSDP in-group composition)."""
     from jax.sharding import NamedSharding, PartitionSpec
 
-    have_tp = tensor_axis in mesh.shape and mesh.shape[tensor_axis] > 1
     have_fsdp = fsdp_axis is not None and fsdp_axis in mesh.shape and (
         mesh.shape[fsdp_axis] > 1
     )
@@ -133,16 +139,20 @@ def make_sharding_fn(
     def _fn(path, leaf):
         shape = getattr(leaf, "shape", ())
         spec: List[Optional[str]] = [None] * len(shape)
-        if have_tp and tp_rules:
+        if tp_rules:
             name = _path_str(path)
-            for pattern, dim in tp_rules:
+            for rule in tp_rules:
+                pattern, dim = rule[0], rule[1]
+                axis = rule[2] if len(rule) > 2 else tensor_axis
                 if re.fullmatch(pattern, name):
                     if (
                         dim is not None
+                        and axis in mesh.shape
+                        and mesh.shape[axis] > 1
                         and dim < len(shape)
-                        and shape[dim] % mesh.shape[tensor_axis] == 0
+                        and shape[dim] % mesh.shape[axis] == 0
                     ):
-                        spec[dim] = tensor_axis
+                        spec[dim] = axis
                     break
         if have_fsdp:
             return fsdp_sharding(
