@@ -68,6 +68,59 @@ def _peak_flops(device) -> "float | None":
 # line, which is exactly the tail pollution _emit exists to prevent.
 _CLEANUPS: "list" = []
 
+# Phase results stashed as they land, so a mid-run crash or an external
+# SIGTERM (driver-imposed timeout) still emits whatever was already
+# measured instead of losing the whole run.
+_PARTIAL: dict = {}
+
+# Liveness marker bumped by every step/phase. The axon TPU tunnel has been
+# observed hanging a device op mid-run (r3: twice — once in the chaos
+# window, once at the T1 boundary), which blocks the main thread in C
+# forever with no Python-level timeout able to fire. A watchdog THREAD
+# still runs during such a hang: if no progress lands for
+# BENCH_WATCHDOG_S (default 300), it emits the partial JSON itself and
+# exits, so the driver always gets an artifact.
+_PROGRESS = {"t": time.monotonic(), "label": "start"}
+
+
+def _touch(label: str) -> None:
+    _PROGRESS["t"] = time.monotonic()
+    _PROGRESS["label"] = label
+
+
+def _start_watchdog() -> None:
+    import threading
+
+    limit = float(os.environ.get("BENCH_WATCHDOG_S", "300"))
+    if limit <= 0:
+        return
+
+    def _watch() -> None:
+        while True:
+            time.sleep(5.0)
+            stalled = time.monotonic() - _PROGRESS["t"]
+            if stalled > limit:
+                payload = {
+                    "metric": "bench_error",
+                    "value": _PARTIAL.get("ft_tokens_per_sec", 0.0),
+                    "unit": "error",
+                    "vs_baseline": _PARTIAL.get("vs_baseline", 0.0),
+                    "error": (
+                        f"watchdog: no progress for {stalled:.0f}s "
+                        f"(last phase: {_PROGRESS['label']})"
+                    ),
+                    **_PARTIAL,
+                }
+                for cleanup in list(_CLEANUPS):
+                    try:
+                        cleanup()
+                    except Exception:  # noqa: BLE001
+                        pass
+                _emit(payload, code=2)
+
+    threading.Thread(target=_watch, name="bench_watchdog",
+                     daemon=True).start()
+
 
 def _emit(payload: dict, code: int = 0) -> None:
     """Print the bench JSON as the process's final act and exit.
@@ -79,6 +132,14 @@ def _emit(payload: dict, code: int = 0) -> None:
     control-plane threads write to C-level fd 2, which rebinding
     sys.stderr cannot intercept — dup2 the fd itself to /dev/null.
     """
+    try:
+        # a SIGTERM landing while the JSON is being written must not
+        # raise into a second emit (two-line tail = unparseable)
+        import signal as _signal
+
+        _signal.signal(_signal.SIGTERM, _signal.SIG_IGN)
+    except Exception:
+        pass
     try:
         sys.stderr.flush()
     except Exception:
@@ -203,7 +264,7 @@ def _maybe_pick_flash(cfg, params, tokens, targets, tx):
     # so measure rather than guess. BENCH_FLASH_BLOCKS="bq:bk,bq:bk,..."
     # overrides. A malformed override must degrade to the defaults, never
     # cost the run its artifact.
-    candidates = [(128, 128), (256, 256), (256, 512)]
+    candidates = [(128, 128), (256, 256), (256, 512), (512, 512)]
     blocks_env = os.environ.get("BENCH_FLASH_BLOCKS")
     if blocks_env:
         try:
@@ -242,7 +303,9 @@ def _maybe_pick_flash(cfg, params, tokens, targets, tx):
     try:
         # numerics cross-check on logits first (the kernel math is shared
         # across block shapes; use the first candidate that compiles)
+        _touch("flash_numerics_xla")
         logits_xla = forward(cfg, params, tokens)
+        _touch("flash_numerics_flash")
         logits_fl = None
         probe_failed = set()
         for bq, bk in candidates:
@@ -263,20 +326,40 @@ def _maybe_pick_flash(cfg, params, tokens, targets, tx):
             jax.numpy.max(jax.numpy.abs(logits_xla - logits_fl))
         )
         scale = float(jax.numpy.max(jax.numpy.abs(logits_xla))) + 1e-6
+        # the [B, S, V] f32 logits pair is ~2 GB at the 125m bench shape —
+        # free it before the timing loops allocate grad state
+        del logits_xla, logits_fl
+        import gc as _gc
+
+        _gc.collect()
         if err / scale > 5e-2:
             return None, "xla", 1.0, err
 
         def time_step(attn_fn):
-            step = make_train_step(cfg, tx, attn_fn=attn_fn, donate=False)
-            p, s = params, tx.init(params)
+            # Pure grad step (no optimizer state): the A/B ranks attention
+            # kernels, and the optax update is an identical constant in
+            # both arms. Keeping opt state out cuts per-candidate HBM by
+            # ~2/3 — with 4-5 candidates and the axon tunnel's lazy buffer
+            # frees, per-candidate train-step state exhausted HBM before
+            # T1 (r3: RESOURCE_EXHAUSTED mid-T1).
+            import gc
+
+            from torchft_tpu.models import make_grad_step as _mk
+
+            step = _mk(cfg, attn_fn=attn_fn)
             for _ in range(2):
-                p, s, loss = step(p, s, tokens, targets)
+                _touch("flash_ab_warmup")
+                loss, grads = step(params, tokens, targets)
             _sync(loss)
             t0 = time.perf_counter()
             for _ in range(5):
-                p, s, loss = step(p, s, tokens, targets)
+                _touch("flash_ab_timing")
+                loss, grads = step(params, tokens, targets)
             _sync(loss)
-            return time.perf_counter() - t0
+            elapsed = time.perf_counter() - t0
+            del grads, loss
+            gc.collect()
+            return elapsed
 
         t_xla = time_step(None)
         best = None  # (time, (bq, bk))
@@ -345,7 +428,12 @@ def _child_main() -> None:
     idx = int(os.environ["BENCH_CHILD_IDX"])
     model_name = os.environ.get("BENCH_MODEL", "125m")
     allow_heal = os.environ.get("BENCH_CHILD_HEAL", "0") == "1"
-    sync_grads = os.environ.get("BENCH_CHILD_SYNC", "0") == "1"
+    # A child that heals joins the cohort as a COUNTED participant, so it
+    # must contribute real gradients — shipping zeros would dilute the
+    # parent's 1/num_participants average for the whole window.
+    sync_grads = (
+        os.environ.get("BENCH_CHILD_SYNC", "0") == "1" or allow_heal
+    )
     standby = os.environ.get("BENCH_CHILD_STANDBY", "0") == "1"
     lighthouse_addr = os.environ["BENCH_LIGHTHOUSE"]
     parent_pid = os.getppid()
@@ -365,8 +453,9 @@ def _child_main() -> None:
             cfg.max_seq_len,
         )
     else:
-        # Background grads on a TPU parent's host: the payload is zeroed
-        # by the manager anyway (behind-cohort), keep the CPU cost small.
+        # Observer on a TPU parent's host: never on the wire, so no grad
+        # computation at all — it must cost the shared host nothing but
+        # control-plane traffic.
         batch = int(os.environ.get("BENCH_CHILD_BATCH", "1"))
         seq = min(cfg.max_seq_len, 256)
     rng = np.random.default_rng(1000 + idx)
@@ -375,11 +464,12 @@ def _child_main() -> None:
     )
     targets = jax.numpy.roll(tokens, -1, axis=1)
     grad_step = make_grad_step(cfg)
-    # Warm up (trace + compile) BEFORE joining the quorum: a registered
-    # replica that is slow to request quorum taxes every peer step with the
-    # lighthouse join timeout, which is exactly the rejoin disruption the
-    # chaos window should NOT double-count.
-    jax.block_until_ready(grad_step(holder["params"], tokens, targets)[1])
+    if allow_heal or sync_grads:
+        # Warm up (trace + compile) BEFORE joining the quorum: a
+        # registered replica that is slow to request quorum taxes every
+        # peer step with the lighthouse join timeout, which is exactly the
+        # rejoin disruption the chaos window should NOT double-count.
+        jax.block_until_ready(grad_step(holder["params"], tokens, targets)[1])
 
     if standby:
         # Warm spare (the FIXED_WITH_SPARES deployment shape): runtime up,
@@ -393,6 +483,13 @@ def _child_main() -> None:
             os._exit(0)  # parent gone before promotion
 
     store = StoreServer()
+    # A child that can heal trains for real and must ride the gradient
+    # wire (it receives the cohort average in its heal step). A child on a
+    # TPU parent's host can never keep pace with the chip and would only
+    # starve the wire — it runs as an OBSERVER (data_plane=False): real
+    # quorum membership, heartbeats and commit-barrier traffic, but the
+    # cohort's transport never includes or waits on it.
+    observer = not (allow_heal or sync_grads)
     manager = Manager(
         comm=TcpCommContext(timeout=60.0),
         load_state_dict=lambda sd: holder.update(sd),
@@ -406,45 +503,32 @@ def _child_main() -> None:
         timeout=60.0,
         quorum_timeout=60.0,
         connect_timeout=60.0,
+        data_plane=not observer,
     )
     ddp = DistributedDataParallel(manager)
-    opt = OptimizerWrapper(manager, tx)
-
-    zero_grads = jax.tree_util.tree_map(
-        lambda l: np.zeros(l.shape, l.dtype),
-        jax.eval_shape(grad_step, holder["params"], tokens, targets)[1],
+    opt = OptimizerWrapper(
+        manager, tx,
+        state_fn=lambda: (holder["params"], holder["opt"]),
     )
-
-    grad_box = {"grads": None}
-    if not sync_grads:
-        # TPU parent: quorum/transport rounds must run at wire speed, so a
-        # real grad computation (slow on CPU at flagship size) happens in
-        # the background and the comm loop ships the latest result. A
-        # behind-cohort replica's payload is zeroed by its own manager
-        # anyway — the wire cost is what matters.
-        def _grad_worker() -> None:
-            while True:
-                try:
-                    _, g = grad_step(holder["params"], tokens, targets)
-                    grad_box["grads"] = jax.block_until_ready(g)
-                except Exception:  # noqa: BLE001 — params mid-heal etc.
-                    time.sleep(0.1)
-
-        threading.Thread(
-            target=_grad_worker, name="child_grads", daemon=True
-        ).start()
 
     while True:
         if os.getppid() != parent_pid:
             os._exit(0)  # orphaned: the parent bench is gone
         try:
+            if observer:
+                # Observer loop: join every quorum round (membership +
+                # heartbeat + long-poll traffic is real) but never touch
+                # the wire and never commit — an observer that advanced
+                # its own step could race into the max-step cohort and
+                # trick the parent into healing FROM it.
+                opt.begin_step(allow_heal=False)
+                manager.wait_quorum()
+                time.sleep(0.02)
+                continue
+            # non-observers always train for real (sync_grads is forced
+            # on for heal-enabled children above)
             opt.begin_step(allow_heal=allow_heal)
-            if sync_grads:
-                _, grads = grad_step(holder["params"], tokens, targets)
-            else:
-                grads = grad_box["grads"]
-                if grads is None:
-                    grads = zero_grads
+            _, grads = grad_step(holder["params"], tokens, targets)
             manager.wait_quorum()
             if manager.replica_world_size() <= 1:
                 # Alone in the quorum (the parent paused or is tearing
@@ -564,10 +648,12 @@ def _run() -> None:
     step_fused = make_train_step(cfg, tx, attn_fn=attn_fn, donate=True)
     p0, s0 = params, tx.init(params)
     for _ in range(warmup):
+        _touch("t0_warmup")
         p0, s0, loss = step_fused(p0, s0, tokens, targets)
     _sync(loss)
     t_start = time.perf_counter()
     for _ in range(steps):
+        _touch("t0_step")
         p0, s0, loss = step_fused(p0, s0, tokens, targets)
         profiler.step()
     _sync(loss)
@@ -575,6 +661,19 @@ def _run() -> None:
     profiler.close()
     t0 = tokens_per_step * steps / t0_elapsed
     del p0, s0
+    import gc as _gc
+
+    _gc.collect()  # release T0 param/opt buffers before T1 allocates its own
+    _PARTIAL.update(
+        fault_free_tokens_per_sec=round(t0, 1),
+        backend=backend, device_kind=device_kind, model=model_name,
+        attn=attn_label, flash_speedup=round(flash_speedup, 3),
+    )
+    if peak_flops is not None:
+        _PARTIAL["mfu_fault_free"] = round(
+            _flops_per_step(cfg, n_params, seq_len, tokens_per_step)
+            * steps / t0_elapsed / peak_flops, 4,
+        )
 
     # ---- T1: full FT loop ----------------------------------------------
     # BENCH_REPLICAS=2 (default): a second replica runs as a real OS
@@ -618,7 +717,12 @@ def _run() -> None:
         connect_timeout=60.0,
     )
     ddp = DistributedDataParallel(manager)
-    opt = OptimizerWrapper(manager, tx)
+    opt = OptimizerWrapper(
+        manager, tx,
+        state_fn=lambda: (
+            opt_state_holder["params"], opt_state_holder["opt"],
+        ),
+    )
 
     children: "list[subprocess.Popen]" = []
     extra_procs: "list[subprocess.Popen]" = []
@@ -672,6 +776,7 @@ def _run() -> None:
     def ft_step():
         nonlocal committed, attempted
         attempted += 1
+        _touch("ft_step")
         _t = time.perf_counter()
         opt.begin_step()
         loss, grads = grad_step(
@@ -756,6 +861,11 @@ def _run() -> None:
     t1 = tokens_per_step * steps / t1_elapsed
     t1_commit_rate = (committed - t1_committed_before) / max(
         1, attempted - t1_attempted_before
+    )
+    _PARTIAL.update(
+        ft_tokens_per_sec=round(t1, 1),
+        vs_baseline=round(t1 / t0, 4),
+        commit_rate=t1_commit_rate,
     )
     # A quorum that shrank mid-window means some steps rode the solo fast
     # path; report the dip so T1 can't silently overstate multi-replica
@@ -946,6 +1056,22 @@ def main() -> None:
     if os.environ.get("BENCH_ROLE") == "child":
         _child_main()
         return
+
+    # An external SIGTERM (driver timeout, operator ^C on a wrapper) must
+    # not kill the process mid-phase with nothing on stdout: raise into
+    # the BaseException path below, which runs cleanups and emits a
+    # parseable line carrying any phase results already measured.
+    import signal
+
+    def _on_term(signum, frame):  # noqa: ARG001
+        raise RuntimeError(f"bench terminated by signal {signum}")
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass  # non-main thread / exotic platform: keep default behavior
+
+    _start_watchdog()
     _devices_or_fallback()
     try:
         _run()
@@ -955,6 +1081,12 @@ def main() -> None:
         # with parseable JSON even when the bench itself breaks
         import traceback
 
+        try:
+            # a SECOND SIGTERM during the (multi-second) cleanup waits
+            # below must not re-raise and kill us before the emit
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        except Exception:
+            pass
         sys.stderr.write(traceback.format_exc())
         for cleanup in list(_CLEANUPS):  # kill children/servers: anything
             try:  # left alive would write to the shared stderr fd after
@@ -964,10 +1096,11 @@ def main() -> None:
         _emit(
             {
                 "metric": "bench_error",
-                "value": 0.0,
+                "value": _PARTIAL.get("ft_tokens_per_sec", 0.0),
                 "unit": "error",
-                "vs_baseline": 0.0,
+                "vs_baseline": _PARTIAL.get("vs_baseline", 0.0),
                 "error": repr(e),
+                **_PARTIAL,
             },
             code=1,
         )

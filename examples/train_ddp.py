@@ -108,7 +108,10 @@ def main() -> None:
         replica_id=f"train_ddp_{replica_group}_",
     )
     ddp = DistributedDataParallel(manager)
-    opt = OptimizerWrapper(manager, tx)
+    opt = OptimizerWrapper(
+        manager, tx,
+        state_fn=lambda: (state["params"], state["opt"]),
+    )
     grad_step = make_grad_step(cfg)
 
     # Durable-checkpoint resume is the user's job (ref train_ddp.py:141-148)

@@ -92,7 +92,10 @@ def main() -> None:
         replica_id=f"hsdp_{replica_group}_",
     )
     ddp = DistributedDataParallel(manager)
-    opt = OptimizerWrapper(manager, tx)
+    opt = OptimizerWrapper(
+        manager, tx,
+        state_fn=lambda: (state["params"], state["opt"]),
+    )
     grad_step = make_grad_step(cfg)
 
     rng = np.random.default_rng(replica_group)

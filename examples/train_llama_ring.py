@@ -91,7 +91,10 @@ def main() -> None:
         replica_id=f"train_llama_ring_{replica_group}_",
     )
     ddp = DistributedDataParallel(manager)
-    opt = OptimizerWrapper(manager, tx)
+    opt = OptimizerWrapper(
+        manager, tx,
+        state_fn=lambda: (state["params"], state["opt"]),
+    )
 
     grad_step = jax.jit(
         lambda p, tok, tgt: jax.value_and_grad(

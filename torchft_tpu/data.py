@@ -182,6 +182,10 @@ class PrefetchIterator:
 
     def close(self) -> None:
         self._stop.set()
+        # latch terminal state FIRST: the drain below may discard the
+        # worker's _DONE sentinel and the stopped worker will never
+        # enqueue again, so a later __next__ must not block on the queue
+        self._finished = True
         # unblock a worker stuck on put()
         try:
             while True:

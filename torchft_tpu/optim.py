@@ -27,14 +27,24 @@ __all__ = ["OptimizerWrapper"]
 
 class OptimizerWrapper:
     """Gates optax updates on the manager's two-phase commit
-    (ref optim.py:24-63)."""
+    (ref optim.py:24-63).
 
-    def __init__(self, manager, tx) -> None:
+    ``state_fn`` (optional) returns the CURRENT (params, opt_state) pair
+    from the same holder the Manager's ``load_state_dict`` writes into.
+    Pass it whenever heals are possible: ``should_commit`` applies a
+    fetched donor checkpoint *during* ``step()``, after the caller already
+    captured its (pre-heal) arguments — without ``state_fn`` the update
+    would be applied to the stale pair and the heal silently discarded.
+    With it, a healed step applies the received average on top of the
+    donor snapshot, ending bitwise-identical to the donor."""
+
+    def __init__(self, manager, tx, state_fn=None) -> None:
         import jax
         import optax
 
         self.manager = manager
         self.tx = tx
+        self._state_fn = state_fn
 
         def _update(grads, opt_state, params):
             updates, new_state = tx.update(grads, opt_state, params)
@@ -59,6 +69,11 @@ class OptimizerWrapper:
         """Apply the update iff the replica group commits this step
         (ref optim.py:53-55). Returns (params, opt_state, committed)."""
         if self.manager.should_commit():
+            if self.manager.did_heal() and self._state_fn is not None:
+                # should_commit just loaded the donor snapshot into the
+                # user's holder; the caller's args predate it. Re-read so
+                # the (received-average) update lands on healed state.
+                params, opt_state = self._state_fn()
             params, opt_state = self._update(grads, opt_state, params)
             return params, opt_state, True
         return params, opt_state, False
