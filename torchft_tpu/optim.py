@@ -38,13 +38,23 @@ class OptimizerWrapper:
     With it, a healed step applies the received average on top of the
     donor snapshot, ending bitwise-identical to the donor."""
 
-    def __init__(self, manager, tx, state_fn=None) -> None:
+    def __init__(self, manager, tx, state_fn=None,
+                 fence_depth: int = 1) -> None:
         import jax
         import optax
 
         self.manager = manager
         self.tx = tx
         self._state_fn = state_fn
+        # Bounded dispatch pipeline. JAX dispatch is async and (on the TPU
+        # tunnel) effectively unbounded: a host loop can race hundreds of
+        # steps ahead of the chip, which makes wall-clock windows lie and
+        # lets should_commit count steps whose device work hasn't run.
+        # fence_depth=1 blocks on the update from ``fence_depth`` steps
+        # ago before committing the current one — full host/device overlap
+        # of one step, but never more. 0 disables.
+        self._fence_depth = fence_depth
+        self._in_flight: list = []
 
         def _update(grads, opt_state, params):
             updates, new_state = tx.update(grads, opt_state, params)
@@ -75,5 +85,20 @@ class OptimizerWrapper:
                 # the (received-average) update lands on healed state.
                 params, opt_state = self._state_fn()
             params, opt_state = self._update(grads, opt_state, params)
+            if self._fence_depth > 0:
+                import jax
+
+                self._in_flight.append(params)
+                if len(self._in_flight) > self._fence_depth:
+                    # Fence via a 1-element D2H readback, not
+                    # block_until_ready: the axon TPU tunnel has been
+                    # observed returning from block_until_ready before
+                    # donated-buffer computations finish (bench.py _sync
+                    # rationale). A device_get cannot lie about
+                    # completion, and one element costs nothing.
+                    leaf = jax.tree_util.tree_leaves(
+                        self._in_flight.pop(0)
+                    )[0]
+                    jax.device_get(leaf[(0,) * getattr(leaf, "ndim", 0)])
             return params, opt_state, True
         return params, opt_state, False
