@@ -722,6 +722,7 @@ def _run() -> None:
         state_fn=lambda: (
             opt_state_holder["params"], opt_state_holder["opt"],
         ),
+        fence_depth=int(os.environ.get("BENCH_FENCE_DEPTH", "1")),
     )
 
     children: "list[subprocess.Popen]" = []
@@ -850,6 +851,9 @@ def _run() -> None:
         loss = ft_step()
     _sync(loss)
     t1_window_start = len(world_seen)
+    # timer deques must describe the MEASURED window, not bring-up spikes
+    # (first quorums while children import jax take hundreds of ms)
+    manager.metrics.reset_timings()
     # commit_rate must describe the MEASURED window, not the (variable-
     # length) bring-up steps
     t1_committed_before, t1_attempted_before = committed, attempted
@@ -867,6 +871,20 @@ def _run() -> None:
         vs_baseline=round(t1 / t0, 4),
         commit_rate=t1_commit_rate,
     )
+    # Where the FT tax goes, from the manager's rolling timers (quorum is
+    # the async-overlapped RPC; commit_barrier is the on-critical-path
+    # two-phase vote; allreduce is the transport op when a wire exists).
+    _m = manager.metrics.snapshot()
+    t1_overhead = {
+        k: round(_m[k], 2)
+        for k in (
+            "quorum_avg_ms", "quorum_max_ms",
+            "commit_barrier_avg_ms", "commit_barrier_max_ms",
+            "allreduce_avg_ms", "allreduce_max_ms",
+        )
+        if k in _m
+    }
+    _PARTIAL["t1_overhead_ms"] = t1_overhead
     # A quorum that shrank mid-window means some steps rode the solo fast
     # path; report the dip so T1 can't silently overstate multi-replica
     # throughput. Participant counts show whether the peers actually
@@ -1015,6 +1033,7 @@ def _run() -> None:
                 None if flash_err != flash_err else flash_err
             ),
             "commit_rate": t1_commit_rate,
+            "t1_overhead_ms": t1_overhead,
             "t1_min_replica_world": t1_min_world,
             "t1_participants_min": min(t1_parts),
             "t1_participants_max": max(t1_parts),

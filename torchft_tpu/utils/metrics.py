@@ -44,6 +44,13 @@ class Metrics:
         finally:
             self.observe(name, time.perf_counter() - start)
 
+    def reset_timings(self) -> None:
+        """Drop rolling timing windows (counters are kept) — call at a
+        measurement-window boundary so earlier spikes (bring-up, warmup)
+        don't pollute the window's percentiles."""
+        with self._lock:
+            self._timings.clear()
+
     def snapshot(self) -> Dict[str, float]:
         """Flat dict: counters as-is; timings as name_avg_ms / name_p max."""
         out: Dict[str, float] = {}
