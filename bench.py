@@ -603,7 +603,11 @@ def _run() -> None:
 
     model_name = os.environ.get("BENCH_MODEL", "125m")
     batch = int(os.environ.get("BENCH_BATCH", "8"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    # 60 steps ≈ 5s of device time at the 125m bench shape: a 20-step
+    # (<2s) window proved fragile on the axon tunnel — a single ~1s
+    # transport hiccup inside it cratered T1 by 2x while the 60s chaos
+    # window sustained the true rate (r3: T1 51k vs chaos 86k).
+    steps = int(os.environ.get("BENCH_STEPS", "60"))
     warmup = max(1, int(os.environ.get("BENCH_WARMUP", "3")))
 
     cfg = CONFIGS[model_name]
