@@ -54,6 +54,18 @@ _PEAK_FLOPS_BY_KIND = [
 ]
 
 
+def _make_tx(optax):
+    """Bench optimizer. BENCH_OPT=adafactor swaps AdamW's two f32 moment
+    trees (~8x params bytes of HBM at 1b) for factored second moments, the
+    standard way to fit a 1b+ model's optimizer state on one chip."""
+    name = os.environ.get("BENCH_OPT", "adamw")
+    if name == "adafactor":
+        return optax.adafactor(learning_rate=3e-4)
+    if name != "adamw":
+        sys.stderr.write(f"bench: unknown BENCH_OPT {name!r}; using adamw\n")
+    return optax.adamw(3e-4, weight_decay=0.01)
+
+
 def _peak_flops(device) -> "float | None":
     kind = str(getattr(device, "device_kind", "")).lower()
     for substr, peak in _PEAK_FLOPS_BY_KIND:
@@ -441,7 +453,7 @@ def _child_main() -> None:
     cfg = CONFIGS[model_name]
     key = jax.random.key(1000 + idx)
     params = init_params(cfg, key)
-    tx = optax.adamw(3e-4, weight_decay=0.01)
+    tx = _make_tx(optax)
     holder = {"params": params, "opt": tx.init(params)}
 
     if sync_grads:
@@ -626,7 +638,7 @@ def _run() -> None:
     key = jax.random.key(0)
     params = init_params(cfg, key)
     n_params = count_params(params)
-    tx = optax.adamw(3e-4, weight_decay=0.01)
+    tx = _make_tx(optax)
 
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(

@@ -631,3 +631,102 @@ def test_recovery_with_compressed_multilane_transport() -> None:
             np.testing.assert_array_equal(w, ws[0])
     for r in runners:
         assert max(r.history) >= 6
+
+
+def test_observer_replica_is_invisible_to_training() -> None:
+    # An observer (Manager(data_plane=False)) joins the quorum alongside
+    # two training replicas: the trainers' trajectory must be EXACTLY the
+    # closed-form two-replica trajectory (if the observer were counted in
+    # num_participants or the wire, the 1/N scaling would change and the
+    # trajectory would diverge), while the observer itself sees the full
+    # 3-member quorum, never participates, and never advances its step.
+    lighthouse = Lighthouse(
+        min_replicas=1, join_timeout_ms=200, heartbeat_timeout_ms=1000
+    )
+    harness = Harness(2, 6)
+    injector = FailureInjector()
+    target = np.full((2, 3), 10.0, dtype=np.float32)
+
+    obs_view = {"world_max": 0, "participated": False, "steps": 0}
+
+    def observer_main() -> None:
+        store = StoreServer()
+        manager = Manager(
+            comm=TcpCommContext(timeout=5.0),
+            load_state_dict=lambda sd: None,
+            state_dict=lambda: {},
+            min_replica_size=1,
+            timeout=5.0,
+            quorum_timeout=5.0,
+            connect_timeout=5.0,
+            rank=0,
+            world_size=1,
+            store_addr=store.addr,
+            lighthouse_addr=lighthouse.address(),
+            replica_id="observer_0_",
+            heartbeat_interval=0.05,
+            data_plane=False,
+        )
+        try:
+            while not harness.stop.is_set():
+                try:
+                    manager.start_quorum(allow_heal=False)
+                    manager.wait_quorum()
+                except (TimeoutError, RuntimeError):
+                    continue
+                obs_view["world_max"] = max(
+                    obs_view["world_max"], manager.replica_world_size()
+                )
+                obs_view["participated"] |= manager.is_participating()
+                obs_view["steps"] = manager.current_step()
+                time.sleep(0.02)
+        finally:
+            manager.shutdown(wait=False)
+            store.shutdown()
+
+    runners = [
+        Runner(i, lighthouse.address(), injector, harness, target=target,
+               replica_prefix="obstrain")
+        for i in range(2)
+    ]
+    obs_thread = threading.Thread(target=observer_main, daemon=True)
+    try:
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            futs = [pool.submit(r.run_replica) for r in runners]
+            obs_thread.start()
+            for f in futs:
+                f.result(timeout=90)
+    finally:
+        harness.stop.set()
+        obs_thread.join(timeout=10)
+        lighthouse.shutdown()
+
+    # Trajectory oracle 1: replicas that committed the same step agree.
+    for step in runners[0].history:
+        if step in runners[1].history:
+            np.testing.assert_allclose(
+                runners[0].history[step], runners[1].history[step],
+                rtol=1e-6, atol=1e-6,
+            )
+    # Trajectory oracle 2: every update's implied contribution ratio must
+    # be a 2-participant scale — 1.0 (both trainers contributed) or 0.5
+    # (one bootstrap-healer contributed zeros). A 3-participant scale
+    # (2/3 or 1/3) would mean the observer was counted in the average.
+    checked = 0
+    for r in runners:
+        steps = sorted(r.history)
+        for a, b in zip(steps, steps[1:]):
+            if b != a + 1:
+                continue
+            w_a, w_b = r.history[a], r.history[b]
+            denom = 0.5 * (w_a - target)
+            ratio = float(np.mean((w_a - w_b) / denom))
+            assert min(abs(ratio - 1.0), abs(ratio - 0.5)) < 1e-4, (
+                f"step {b}: implied contribution ratio {ratio} is not a "
+                "2-participant scale — observer contaminated the average?"
+            )
+            checked += 1
+    assert checked >= 4  # the oracle actually ran over real transitions
+    assert obs_view["world_max"] == 3, obs_view  # saw the full quorum
+    assert not obs_view["participated"]
+    assert obs_view["steps"] == 0  # never committed
