@@ -306,9 +306,13 @@ QuorumResults compute_quorum_results(const std::string& replica_id,
     out.max_replica_ids.push_back(participants[mi].replica_id);
   }
   // Data-plane membership: everyone who did not opt out, in sorted order
-  // (so all members derive identical transport ranks).
-  for (const auto& p : participants) {
-    if (!p.data_plane) continue;
+  // (so all members derive identical transport ranks). Uses dp_indices,
+  // not the per-member flag, so the all-observer degenerate fallback
+  // (dp_indices = full membership above) emits a coherent wire instead of
+  // electing observer primaries/donors while leaving the transport empty
+  // for Python's legacy-control-plane branch to guess at.
+  for (size_t i : dp_indices) {
+    const auto& p = participants[i];
     if (p.replica_id == replica_id) {
       out.transport_rank =
           static_cast<int64_t>(out.transport_replica_ids.size());

@@ -109,6 +109,45 @@ def test_bench_error_path_still_emits_json():
     assert "value" in payload and "vs_baseline" in payload
 
 
+def test_bench_wedged_probe_fallback_survives_watchdog():
+    """r3's graded artifact was destroyed by the watchdog firing while the
+    parent legitimately waited on the probe / CPU-fallback child
+    (bench.py `_devices_or_fallback`) — no progress touch on that path, so
+    at BENCH_WATCHDOG_S the parent emitted bench_error and os._exit(2)'d,
+    killing the child doing the work. This reproduces the exact geometry:
+    a probe that hangs LONGER than the watchdog limit (so the old code is
+    guaranteed to fire mid-wait), then a CPU fallback run. The driver-style
+    tail must parse to a THROUGHPUT metric, not bench_error."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("PYTHONPATH", "XLA_FLAGS")
+    }
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_TEST_PROBE_HANG="1",     # probe wedges (never finishes)
+        BENCH_INIT_TIMEOUT="25",       # probe wait outlives the watchdog…
+        BENCH_WATCHDOG_S="20",         # …so the old code fired right here
+        BENCH_FALLBACK_WATCHDOG_S="300",  # child gets a sane budget
+        BENCH_MODEL="tiny",
+        BENCH_STEPS="2",
+        BENCH_REPLICAS="1",
+        BENCH_CHAOS="0",
+    )
+    out = subprocess.run(
+        [sys.executable, _BENCH],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=420,
+    )
+    payload = _last_line_json(out)
+    assert payload["metric"] != "bench_error", payload
+    assert payload["metric"].startswith("ft_tokens_per_sec")
+    assert payload["value"] > 0
+    assert out.returncode == 0
+
+
 def test_bench_flagship_cpu_smoke():
     """The 125m flagship config must run in the graded loop (full param
     set, real vocab, real bucketing shapes) even when only a CPU is

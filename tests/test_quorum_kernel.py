@@ -344,3 +344,24 @@ def test_observers_invisible_to_step_and_recovery_logic() -> None:
     assert res_obs["heal"] is False
     assert res_obs["max_rank"] is None
     assert res_obs["transport_rank"] is None
+
+
+def test_all_observer_fallback_emits_coherent_transport() -> None:
+    # Degenerate quorum where EVERY member is an observer: the kernel
+    # falls back to treating the full membership as data-plane so it
+    # stays total — and the transport fields must describe that same
+    # fallback membership, not stay empty (which would push Python onto
+    # the legacy full-membership branch while the kernel had elected
+    # observer primaries/donors; ADVICE r3 #1).
+    parts = [
+        {**member("a", step=3), "data_plane": False},
+        {**member("b", step=3), "data_plane": False},
+    ]
+    res_a = compute_quorum_results("a", 0, parts)
+    assert res_a["transport_replica_ids"] == ["a", "b"]
+    assert res_a["transport_rank"] == 0
+    assert res_a["transport_world_size"] == 2
+    res_b = compute_quorum_results("b", 0, parts)
+    assert res_b["transport_rank"] == 1
+    # and the fallback election itself still holds
+    assert res_b["max_replica_ids"] == ["a", "b"]

@@ -53,6 +53,13 @@ class OptimizerWrapper:
         # fence_depth=1 blocks on the update from ``fence_depth`` steps
         # ago before committing the current one — full host/device overlap
         # of one step, but never more. 0 disables.
+        #
+        # HBM cost: the fence keeps the last ``fence_depth`` committed
+        # params pytrees referenced until their turn to be waited on —
+        # one extra full parameter tree of HBM at the default depth. The
+        # list is drained on every non-committing step (below) so a stale
+        # reference can never outlive the step that created it by more
+        # than the fence window.
         self._fence_depth = fence_depth
         self._in_flight: list = []
 
@@ -101,4 +108,16 @@ class OptimizerWrapper:
                     # real chip (docs/evidence/bench_tpu_r3.json).
                     jax.block_until_ready(self._in_flight.pop(0))
             return params, opt_state, True
+        # Non-committing step (error latched, insufficient quorum, heal
+        # retry): drain the fence by WAITING, not dropping — dropping
+        # would let the first commit after a non-commit stretch dispatch
+        # without blocking on the prior update (two unawaited steps
+        # outstanding, exactly what the fence exists to prevent), and a
+        # discarded step has no latency to protect anyway. Waiting also
+        # releases the references, bounding stale HBM retention.
+        if self._in_flight:
+            import jax
+
+            while self._in_flight:
+                jax.block_until_ready(self._in_flight.pop(0))
         return params, opt_state, False
