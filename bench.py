@@ -758,7 +758,12 @@ def _run() -> None:
             cfg, params, tokens, targets, tx
         )
     else:
-        attn_fn, attn_label, flash_speedup, flash_err = None, "xla", 0.0, 0.0
+        # flash skipped (no pallas backend on CPU): the error bound is
+        # UNMEASURED — report null, never 0.0, which would read as "bit
+        # exact, validated" in the artifact (VERDICT r3 weak #5).
+        attn_fn, attn_label, flash_speedup, flash_err = (
+            None, "xla", 0.0, float("nan")
+        )
 
     # ---- T0: fault-free fused train step --------------------------------
     # TORCHFT_TPU_PROFILE_DIR=/tmp/trace captures an XLA trace of a few
@@ -789,6 +794,7 @@ def _run() -> None:
         fault_free_tokens_per_sec=round(t0, 1),
         backend=backend, device_kind=device_kind, model=model_name,
         attn=attn_label, flash_speedup=round(flash_speedup, 3),
+        flash_max_err=None if flash_err != flash_err else flash_err,
     )
     if peak_flops is not None:
         _PARTIAL["mfu_fault_free"] = round(
@@ -889,6 +895,7 @@ def _run() -> None:
 
     committed = 0
     attempted = 0
+    last_loss = [jnp.zeros((), jnp.float32)]  # sync anchor for discarded steps
     world_seen = []  # quorum membership per step
     parts_seen = []  # committing-cohort size per step
 
@@ -901,17 +908,42 @@ def _run() -> None:
         _touch("ft_step")
         _t = time.perf_counter()
         opt.begin_step()
-        loss, grads = grad_step(
-            opt_state_holder["params"], tokens, targets
-        )
-        avg = ddp.average_gradients(grads)
-        p, s, ok = opt.step(
-            opt_state_holder["params"], opt_state_holder["opt"], avg
-        )
+        # Per-step path choice, keyed on THIS step's quorum: a solo wire
+        # (no data-plane peer) runs the commit barrier then ONE fused
+        # grad+update program — the same donated executable T0 timed, so
+        # the FT tax is just quorum+barrier RPCs and the scalar fence
+        # (VERDICT r3 #2: the two-program dispatch was most of the ~16ms
+        # fixed cost). The moment a peer is on the wire (heals in on
+        # CPU), the step falls back to grad → transport average → gated
+        # update, unchanged.
+        try:
+            manager.wait_quorum()
+            fuse = opt.can_fuse()
+        except Exception:  # noqa: BLE001 — latched by the classic path
+            fuse = False
+        if fuse:
+            p, s, loss, ok = opt.fused_step(
+                step_fused, opt_state_holder["params"],
+                opt_state_holder["opt"], tokens, targets,
+            )
+            if loss is None:
+                # discarded fused step dispatched nothing; the window
+                # syncs (_sync(loss)) must still have a real array to
+                # force — the previous step's chain is the right one.
+                loss = last_loss[0]
+        else:
+            loss, grads = grad_step(
+                opt_state_holder["params"], tokens, targets
+            )
+            avg = ddp.average_gradients(grads)
+            p, s, ok = opt.step(
+                opt_state_holder["params"], opt_state_holder["opt"], avg
+            )
         if ok:
             committed += 1
             opt_state_holder["params"] = p
             opt_state_holder["opt"] = s
+        last_loss[0] = loss
         world_seen.append(manager.replica_world_size())
         parts_seen.append(manager.num_participants())
         if trace_path:
@@ -978,6 +1010,7 @@ def _run() -> None:
     # commit_rate must describe the MEASURED window, not the (variable-
     # length) bring-up steps
     t1_committed_before, t1_attempted_before = committed, attempted
+    t1_fused_before, t1_classic_before = opt.fused_steps, opt.classic_steps
     t_start = time.perf_counter()
     for _ in range(steps):
         loss = ft_step()
@@ -987,10 +1020,16 @@ def _run() -> None:
     t1_commit_rate = (committed - t1_committed_before) / max(
         1, attempted - t1_attempted_before
     )
+    # Path mix of the MEASURED window only (lifetime-cumulative counts
+    # would let bring-up/chaos steps masquerade as T1's path).
+    t1_fused = opt.fused_steps - t1_fused_before
+    t1_classic = opt.classic_steps - t1_classic_before
     _PARTIAL.update(
         ft_tokens_per_sec=round(t1, 1),
         vs_baseline=round(t1 / t0, 4),
         commit_rate=t1_commit_rate,
+        t1_fused_steps=t1_fused,
+        t1_classic_steps=t1_classic,
     )
     # Where the FT tax goes, from the manager's rolling timers (quorum is
     # the async-overlapped RPC; commit_barrier is the on-critical-path
@@ -1023,6 +1062,7 @@ def _run() -> None:
         os.environ.get("BENCH_CHAOS", "1") != "0" and n_replicas >= 2
     )
     t2 = chaos_commit_rate = None
+    chaos_fused = chaos_classic = None
     chaos_participants_end = chaos_world_end = None
     chaos_respawn = None
     chaos_seconds = float(os.environ.get("BENCH_CHAOS_SECONDS", "60"))
@@ -1066,6 +1106,8 @@ def _run() -> None:
                 chaos_respawn = "cold"
 
             committed_before, attempted_before = committed, attempted
+            chaos_fused_before = opt.fused_steps
+            chaos_classic_before = opt.classic_steps
             t_start = time.perf_counter()
             kill_at = t_start + chaos_seconds / 4
             respawn_at = None
@@ -1121,6 +1163,8 @@ def _run() -> None:
             # additionally proves it healed back into the cohort
             chaos_world_end = manager.replica_world_size()
             chaos_participants_end = manager.num_participants()
+            chaos_fused = opt.fused_steps - chaos_fused_before
+            chaos_classic = opt.classic_steps - chaos_classic_before
 
     if trace_path:
         with open(trace_path, "w") as f:
@@ -1155,6 +1199,8 @@ def _run() -> None:
             ),
             "commit_rate": t1_commit_rate,
             "t1_overhead_ms": t1_overhead,
+            "t1_fused_steps": t1_fused,
+            "t1_classic_steps": t1_classic,
             "t1_min_replica_world": t1_min_world,
             "t1_participants_min": min(t1_parts),
             "t1_participants_max": max(t1_parts),
@@ -1180,6 +1226,8 @@ def _run() -> None:
             "chaos_replica_world_end": chaos_world_end,
             "chaos_participants_end": chaos_participants_end,
             "chaos_respawn": chaos_respawn,
+            "chaos_fused_steps": chaos_fused,
+            "chaos_classic_steps": chaos_classic,
             "replicas": n_replicas,
             "child_replicas_heal": child_heal,
             "model": model_name,

@@ -297,3 +297,87 @@ def test_ddp_buckets_issue_pipelined() -> None:
         f"x {delay}s"
     )
     np.testing.assert_allclose(out["a"], grads["a"])
+
+
+def test_fused_step_commit_and_rollover() -> None:
+    # Solo-wire fast path: barrier first, then ONE fused program; a
+    # discarded step dispatches nothing (donation-safe by construction).
+    manager = mock_manager(commit=True)
+    manager.errored.return_value = None
+    manager.transport_world_size.return_value = 1
+    manager.is_participating.return_value = True
+    manager.did_heal.return_value = False
+    tx = optax.sgd(0.1)
+    opt = OptimizerWrapper(manager, tx)
+    assert opt.can_fuse()
+    calls = []
+
+    def fused(params, state, x):
+        calls.append(x)
+        g = {"w": jnp.full(3, 2.0)}
+        upd, state = tx.update(g, state, params)
+        return optax.apply_updates(params, upd), state, jnp.sum(params["w"])
+
+    params = {"w": jnp.ones(3)}
+    state = opt.init(params)
+    p2, s2, aux, ok = opt.fused_step(fused, params, state, 7)
+    assert ok and calls == [7]
+    np.testing.assert_allclose(p2["w"], np.full(3, 0.8), rtol=1e-6)
+    assert float(aux) == 3.0
+    assert opt.fused_steps == 1
+
+    # discarded step: fused_fn must NOT be dispatched
+    manager.should_commit.return_value = False
+    p3, s3, aux3, ok3 = opt.fused_step(fused, p2, s2, 8)
+    assert not ok3 and calls == [7]
+    assert aux3 is None
+    assert p3 is p2 and s3 is s2
+
+
+def test_fused_step_heal_rereads_state() -> None:
+    # A heal lands in should_commit; the fused dispatch must use the
+    # donor snapshot, not the caller's stale args.
+    manager = mock_manager(commit=True)
+    manager.did_heal.return_value = True
+    healed = ({"w": jnp.full(3, 42.0)}, "healed_state")
+    tx = optax.sgd(0.1)
+    opt = OptimizerWrapper(manager, tx, state_fn=lambda: healed)
+    seen = []
+
+    def fused(params, state, *a):
+        seen.append((params, state))
+        return params, state, jnp.float32(0)
+
+    stale = {"w": jnp.zeros(3)}
+    opt.fused_step(fused, stale, "stale_state")
+    assert seen[0][1] == "healed_state"
+    np.testing.assert_array_equal(seen[0][0]["w"], np.full(3, 42.0))
+
+
+def test_fused_step_drains_classic_fence_before_donation() -> None:
+    # classic->fused transition: the fence holds the previous classic
+    # step's (non-donated) params tree — the very buffers the fused
+    # program donates. fused_step must wait them out BEFORE dispatch
+    # (block_until_ready on a donated buffer raises on real backends).
+    manager = mock_manager(commit=True)
+    manager.errored.return_value = None
+    manager.transport_world_size.return_value = 1
+    manager.is_participating.return_value = True
+    manager.did_heal.return_value = False
+    tx = optax.sgd(0.1)
+    opt = OptimizerWrapper(manager, tx, fence_depth=2)
+    params = {"w": jnp.ones(3)}
+    state = opt.init(params)
+    p1, s1, _ = opt.step(params, state, {"w": jnp.full(3, 2.0)})
+    assert len(opt._in_flight) == 1
+    assert opt._in_flight[0][0] == "block"
+
+    def fused(p, s, *a):
+        # at dispatch time the fence must hold no classic entries
+        assert not any(k == "block" for k, _ in opt._in_flight)
+        return p, s, jnp.float32(1)
+
+    p2, s2, aux, ok = opt.fused_step(fused, p1, s1)
+    assert ok
+    # steady-state fused entries are loss scalars
+    assert [k for k, _ in opt._in_flight] == ["readback"]

@@ -62,6 +62,11 @@ class OptimizerWrapper:
         # than the fence window.
         self._fence_depth = fence_depth
         self._in_flight: list = []
+        # Path counters (observability: the bench reports how many steps
+        # rode each path so an artifact can't silently claim fused-path
+        # throughput for a wire that was never solo, or vice versa).
+        self.fused_steps = 0
+        self.classic_steps = 0
 
         def _update(grads, opt_state, params):
             updates, new_state = tx.update(grads, opt_state, params)
@@ -85,6 +90,7 @@ class OptimizerWrapper:
     ) -> Tuple[Any, Any, bool]:
         """Apply the update iff the replica group commits this step
         (ref optim.py:53-55). Returns (params, opt_state, committed)."""
+        self.classic_steps += 1
         if self.manager.should_commit():
             if self.manager.did_heal() and self._state_fn is not None:
                 # should_commit just loaded the donor snapshot into the
@@ -92,21 +98,15 @@ class OptimizerWrapper:
                 # the (received-average) update lands on healed state.
                 params, opt_state = self._state_fn()
             params, opt_state = self._update(grads, opt_state, params)
-            if self._fence_depth > 0:
-                import jax
-
-                self._in_flight.append(params)
-                if len(self._in_flight) > self._fence_depth:
-                    # block_until_ready, deliberately NOT a device_get
-                    # readback: a 1-element D2H fence was measured to cost
-                    # a full tunnel round trip per step (125m bench:
-                    # vs_baseline 0.89 -> 0.50). block_until_ready's known
-                    # early-return pathology is specific to DONATED-buffer
-                    # chains (bench.py _sync rationale); these updates are
-                    # not donated, and its backpressure here is validated
-                    # by matched window/committed-step accounting on the
-                    # real chip (docs/evidence/bench_tpu_r3.json).
-                    jax.block_until_ready(self._in_flight.pop(0))
+            # block_until_ready, deliberately NOT a device_get readback:
+            # a 1-element D2H fence was measured to cost a full tunnel
+            # round trip per step (125m bench: vs_baseline 0.89 -> 0.50).
+            # block_until_ready's known early-return pathology is specific
+            # to DONATED-buffer chains (bench.py _sync rationale); these
+            # updates are not donated, and its backpressure here is
+            # validated by matched window/committed-step accounting on the
+            # real chip (docs/evidence/bench_tpu_r3.json).
+            self._push_fence("block", params)
             return params, opt_state, True
         # Non-committing step (error latched, insufficient quorum, heal
         # retry): drain the fence by WAITING, not dropping — dropping
@@ -115,9 +115,92 @@ class OptimizerWrapper:
         # outstanding, exactly what the fence exists to prevent), and a
         # discarded step has no latency to protect anyway. Waiting also
         # releases the references, bounding stale HBM retention.
-        if self._in_flight:
-            import jax
-
-            while self._in_flight:
-                jax.block_until_ready(self._in_flight.pop(0))
+        self._drain_fence()
         return params, opt_state, False
+
+    def _push_fence(self, kind: str, value: Any) -> None:
+        """Enqueue a fence entry and wait out the one from ``fence_depth``
+        steps ago. kind "block" waits with block_until_ready (a
+        non-donated pytree); kind "readback" does a scalar device_get (a
+        loss from a DONATED chain, where block_until_ready can lie on the
+        tunnel — completion of one output of an XLA execution implies the
+        whole execution ran)."""
+        if self._fence_depth <= 0:
+            return
+        self._in_flight.append((kind, value))
+        if len(self._in_flight) > self._fence_depth:
+            self._wait_entry(*self._in_flight.pop(0))
+
+    def _drain_fence(self) -> None:
+        while self._in_flight:
+            self._wait_entry(*self._in_flight.pop(0))
+
+    @staticmethod
+    def _wait_entry(kind: str, value: Any) -> None:
+        import jax
+
+        if kind == "block":
+            jax.block_until_ready(value)
+        else:
+            import numpy as np
+
+            np.asarray(jax.device_get(value))
+
+    def can_fuse(self) -> bool:
+        """True when THIS step's wire is solo (quorum already waited):
+        no data-plane peer means the cross-replica average is an identity,
+        so the whole step can run as one fused grad+update program via
+        :meth:`fused_step`. The quorum and commit barrier still run — they
+        are what detect rejoining peers and membership changes."""
+        m = self.manager
+        return (
+            m.errored() is None
+            and m.transport_world_size() == 1
+            and m.is_participating()
+        )
+
+    def fused_step(
+        self, fused_fn, params: Any, opt_state: Any, *args
+    ) -> Tuple[Any, Any, Any, bool]:
+        """Solo-wire fast path: commit barrier FIRST, then dispatch ONE
+        fused grad+update program. Returns (params, opt_state, aux,
+        committed); aux is ``fused_fn``'s third output (the loss) or None
+        on a discarded step.
+
+        Why barrier-before-dispatch is sound: the local vote never
+        depends on gradient VALUES — it is "no transport error latched
+        and enough participants" (ref manager.py:545-598) — and a solo
+        wire has no transport ops that could fail between the vote and
+        the update. Deciding first makes buffer DONATION safe (a
+        discarded step dispatches nothing, so there is nothing to roll
+        back), which halves peak params+opt HBM vs the non-donated
+        two-program path — the difference that closes the 1b FT row.
+
+        The fence differs from :meth:`step`: donated-buffer chains are
+        exactly the case where ``block_until_ready`` has been observed
+        returning early on the TPU tunnel (bench.py ``_sync`` rationale),
+        so the fence here is a scalar ``device_get`` of the loss from
+        ``fence_depth`` steps ago — one guaranteed-complete readback per
+        step, and completion of any output of an XLA execution implies
+        the whole execution (the donated params update included) ran.
+
+        Callers MUST check :meth:`can_fuse` after ``wait_quorum`` each
+        step and use the grad/average/:meth:`step` path otherwise."""
+        self.fused_steps += 1
+        if self.manager.should_commit():
+            if self.manager.did_heal() and self._state_fn is not None:
+                # the barrier just loaded the donor snapshot; recompute on
+                # the healed pair, not the caller's stale references
+                params, opt_state = self._state_fn()
+            if any(kind == "block" for kind, _ in self._in_flight):
+                # classic->fused transition: a "block" entry IS the params
+                # tree we are about to donate; wait it out while its
+                # buffers are still valid (block_until_ready on a donated
+                # buffer raises). Transition steps only — steady-state
+                # fused entries are loss scalars.
+                self._drain_fence()
+            params, opt_state, aux = fused_fn(params, opt_state, *args)
+            self._push_fence("readback", aux)
+            return params, opt_state, aux, True
+        self._drain_fence()
+        return params, opt_state, None, False
