@@ -1011,6 +1011,8 @@ def _run() -> None:
     # length) bring-up steps
     t1_committed_before, t1_attempted_before = committed, attempted
     t1_fused_before, t1_classic_before = opt.fused_steps, opt.classic_steps
+    for _dq in opt.phase_ms.values():
+        _dq.clear()  # breakdown must describe the measured window
     t_start = time.perf_counter()
     for _ in range(steps):
         loss = ft_step()
@@ -1024,12 +1026,21 @@ def _run() -> None:
     # would let bring-up/chaos steps masquerade as T1's path).
     t1_fused = opt.fused_steps - t1_fused_before
     t1_classic = opt.classic_steps - t1_classic_before
+    # Fused-path phase breakdown (ms, T1 window): where the FT tax goes.
+    # fence absorbs residual device time of the previous step (big fence
+    # = device-bound, host overhead irrelevant); dispatch is per-program
+    # host/tunnel overhead; barrier is the 2-phase commit RPC.
+    t1_phase_ms = {
+        name: round(sum(dq) / len(dq), 3)
+        for name, dq in opt.phase_ms.items() if dq
+    }
     _PARTIAL.update(
         ft_tokens_per_sec=round(t1, 1),
         vs_baseline=round(t1 / t0, 4),
         commit_rate=t1_commit_rate,
         t1_fused_steps=t1_fused,
         t1_classic_steps=t1_classic,
+        t1_phase_ms=t1_phase_ms,
     )
     # Where the FT tax goes, from the manager's rolling timers (quorum is
     # the async-overlapped RPC; commit_barrier is the on-critical-path
@@ -1201,6 +1212,7 @@ def _run() -> None:
             "t1_overhead_ms": t1_overhead,
             "t1_fused_steps": t1_fused,
             "t1_classic_steps": t1_classic,
+            "t1_phase_ms": t1_phase_ms,
             "t1_min_replica_world": t1_min_world,
             "t1_participants_min": min(t1_parts),
             "t1_participants_max": max(t1_parts),

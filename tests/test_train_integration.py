@@ -24,6 +24,7 @@ def mock_manager(commit=True, use_async=True):
     m.should_commit.return_value = commit
     m._use_async_quorum = use_async
     m.num_participants.return_value = 1
+    m.is_solo_wire.return_value = False  # exercise the real transport path
     # identity allreduce: average over 1 participant
     m.allreduce_arrays.side_effect = lambda arrays, **kw: CompletedWork(
         [np.array(a, copy=True) for a in arrays]
@@ -306,6 +307,7 @@ def test_fused_step_commit_and_rollover() -> None:
     manager.errored.return_value = None
     manager.transport_world_size.return_value = 1
     manager.is_participating.return_value = True
+    manager.is_solo_wire.return_value = True
     manager.did_heal.return_value = False
     tx = optax.sgd(0.1)
     opt = OptimizerWrapper(manager, tx)
@@ -363,6 +365,7 @@ def test_fused_step_drains_classic_fence_before_donation() -> None:
     manager.errored.return_value = None
     manager.transport_world_size.return_value = 1
     manager.is_participating.return_value = True
+    manager.is_solo_wire.return_value = True
     manager.did_heal.return_value = False
     tx = optax.sgd(0.1)
     opt = OptimizerWrapper(manager, tx, fence_depth=2)

@@ -136,11 +136,7 @@ class DistributedDataParallel:
             # commit without any quorum at all.
             self._manager.report_error(e)
             return completed_future(grads)
-        if (
-            self._manager.errored() is None
-            and self._manager.transport_world_size() == 1
-            and self._manager.is_participating()
-        ):
+        if self._manager.is_solo_wire():
             return completed_future(grads)
 
         leaves, treedef = jax.tree_util.tree_flatten(grads)

@@ -723,6 +723,21 @@ class Manager:
         allreduce)."""
         return self._transport_world_size
 
+    def is_solo_wire(self) -> bool:
+        """True when THIS quorum's wire is an identity for this replica:
+        no error latched, no data-plane peer, and we are participating.
+        THE solo-wire predicate — `ddp.average_gradients_async` uses it to
+        skip the transport round trip, `OptimizerWrapper.can_fuse` to run
+        the one-program fused commit. One definition so the two sites can
+        never drift (a skew would let the optimizer fuse — skipping the
+        average — on a wire the DDP layer still considers shared). Valid
+        only after ``wait_quorum`` for the current step."""
+        return (
+            self.errored() is None
+            and self._transport_world_size == 1
+            and self.is_participating()
+        )
+
     def participating_rank(self) -> Optional[int]:
         return self._participating_rank
 

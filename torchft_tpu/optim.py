@@ -67,6 +67,20 @@ class OptimizerWrapper:
         # throughput for a wire that was never solo, or vice versa).
         self.fused_steps = 0
         self.classic_steps = 0
+        # Per-phase wall timings of recent fused steps (bounded): where
+        # the FT tax goes — the commit barrier RPC, the program dispatch,
+        # and the fence readback. The fence entry is the interesting one
+        # on a remote-dispatch backend: it absorbs whatever device time
+        # step N-1 still needs, so fence >> barrier+dispatch means the
+        # host is NOT the bottleneck (the tax is device/transport time),
+        # while large dispatch means per-program host overhead.
+        from collections import deque
+
+        self.phase_ms = {
+            "barrier": deque(maxlen=512),
+            "dispatch": deque(maxlen=512),
+            "fence": deque(maxlen=512),
+        }
 
         def _update(grads, opt_state, params):
             updates, new_state = tx.update(grads, opt_state, params)
@@ -152,12 +166,7 @@ class OptimizerWrapper:
         so the whole step can run as one fused grad+update program via
         :meth:`fused_step`. The quorum and commit barrier still run — they
         are what detect rejoining peers and membership changes."""
-        m = self.manager
-        return (
-            m.errored() is None
-            and m.transport_world_size() == 1
-            and m.is_participating()
-        )
+        return self.manager.is_solo_wire()
 
     def fused_step(
         self, fused_fn, params: Any, opt_state: Any, *args
@@ -184,10 +193,29 @@ class OptimizerWrapper:
         step, and completion of any output of an XLA execution implies
         the whole execution (the donated params update included) ran.
 
+        Failure-after-vote window: the barrier advances step and
+        batches_committed BEFORE the fused compute is dispatched, so a
+        dispatch failure (e.g. RESOURCE_EXHAUSTED at first compile)
+        leaves the counters one ahead of the applied updates. This is the
+        REFERENCE's semantics too — should_commit increments step and the
+        torch optimizer.step() runs after it and can fail the same way
+        (ref manager.py:594-596, optim.py:53-55); the fused path only
+        widens the window to the whole step. Recovery is identical:
+        the raise crashes the step, the replica restarts and heals from a
+        peer (or resumes a durable checkpoint, which snapshots counters
+        and params atomically). Warm the fused executable before the FT
+        loop (as the bench's T0 does) to keep first-compile failures out
+        of the window.
+
         Callers MUST check :meth:`can_fuse` after ``wait_quorum`` each
         step and use the grad/average/:meth:`step` path otherwise."""
+        import time as _time
+
         self.fused_steps += 1
+        _t0 = _time.perf_counter()
         if self.manager.should_commit():
+            _t1 = _time.perf_counter()
+            self.phase_ms["barrier"].append((_t1 - _t0) * 1e3)
             if self.manager.did_heal() and self._state_fn is not None:
                 # the barrier just loaded the donor snapshot; recompute on
                 # the healed pair, not the caller's stale references
@@ -200,7 +228,12 @@ class OptimizerWrapper:
                 # fused entries are loss scalars.
                 self._drain_fence()
             params, opt_state, aux = fused_fn(params, opt_state, *args)
+            _t2 = _time.perf_counter()
+            self.phase_ms["dispatch"].append((_t2 - _t1) * 1e3)
             self._push_fence("readback", aux)
+            self.phase_ms["fence"].append(
+                (_time.perf_counter() - _t2) * 1e3
+            )
             return params, opt_state, aux, True
         self._drain_fence()
         return params, opt_state, None, False
