@@ -1011,8 +1011,7 @@ def _run() -> None:
     # length) bring-up steps
     t1_committed_before, t1_attempted_before = committed, attempted
     t1_fused_before, t1_classic_before = opt.fused_steps, opt.classic_steps
-    for _dq in opt.phase_ms.values():
-        _dq.clear()  # breakdown must describe the measured window
+    opt.metrics.reset_timings()  # breakdown must describe the window
     t_start = time.perf_counter()
     for _ in range(steps):
         loss = ft_step()
@@ -1030,9 +1029,10 @@ def _run() -> None:
     # fence absorbs residual device time of the previous step (big fence
     # = device-bound, host overhead irrelevant); dispatch is per-program
     # host/tunnel overhead; barrier is the 2-phase commit RPC.
+    _opt_m = opt.metrics.snapshot()
     t1_phase_ms = {
-        name: round(sum(dq) / len(dq), 3)
-        for name, dq in opt.phase_ms.items() if dq
+        k[: -len("_avg_ms")]: round(v, 3)
+        for k, v in _opt_m.items() if k.endswith("_avg_ms")
     }
     _PARTIAL.update(
         ft_tokens_per_sec=round(t1, 1),
@@ -1248,6 +1248,10 @@ def _run() -> None:
             "seq_len": seq_len,
             "backend": backend,
             "device_kind": device_kind,
+            # 2-replica CPU runs share these cores between both trainers;
+            # vs_baseline on a 1-core host is dominated by that contention
+            # (a sandbox artifact — on TPU the replicas own separate chips)
+            "host_cores": len(os.sched_getaffinity(0)),
         }
     )
 

@@ -113,6 +113,12 @@ def main() -> None:
         state_fn=lambda: (state["params"], state["opt"]),
     )
     grad_step = make_grad_step(cfg)
+    # One fused grad+update executable for solo-wire steps (no data-plane
+    # peer): commit barrier first, then a single donated program — the
+    # cheap path a single-group (or temporarily-alone) deployment rides.
+    from torchft_tpu.models import make_train_step
+
+    fused_step = make_train_step(cfg, tx, donate=True)
 
     # Durable-checkpoint resume is the user's job (ref train_ddp.py:141-148)
     # — the manager state_dict MUST be part of it. Checkpoints are
@@ -146,17 +152,39 @@ def main() -> None:
         while manager.current_step() < total_steps:
             tokens, targets = next_batch()
             opt.begin_step()
-            loss, grads = grad_step(state["params"], tokens, targets)
-            avg = ddp.average_gradients(grads)
-            new_params, new_opt, committed = opt.step(
-                state["params"], state["opt"], avg
-            )
+            try:
+                manager.wait_quorum()
+                fuse = opt.can_fuse()
+            except Exception:  # noqa: BLE001 — whatever the quorum threw
+                # (timeout, malformed response, donor staging error), the
+                # classic path re-waits and LATCHES it so the step is
+                # discarded instead of crashing the loop
+                fuse = False
+            if fuse:
+                new_params, new_opt, loss, committed = opt.fused_step(
+                    fused_step, state["params"], state["opt"],
+                    tokens, targets,
+                )
+            else:
+                loss, grads = grad_step(state["params"], tokens, targets)
+                avg = ddp.average_gradients(grads)
+                new_params, new_opt, committed = opt.step(
+                    state["params"], state["opt"], avg
+                )
             if committed:
                 state["params"], state["opt"] = new_params, new_opt
                 step = manager.current_step()
+                # Loss is read back only at checkpoint steps: float(loss)
+                # is a synchronous D2H that would re-serialize host and
+                # device every step — the exact round trip the fused
+                # path's delayed fence exists to avoid (optim.py fence
+                # rationale; ~1 tunnel RTT per step measured).
+                loss_part = (
+                    f" loss {float(loss):.4f}" if step % 10 == 0 else ""
+                )
                 print(
-                    f"[group {replica_group}] step {step} "
-                    f"loss {float(loss):.4f} "
+                    f"[group {replica_group}] step {step}"
+                    f"{loss_part} "
                     f"participants {manager.num_participants()}"
                 )
                 if step % 10 == 0:

@@ -67,20 +67,18 @@ class OptimizerWrapper:
         # throughput for a wire that was never solo, or vice versa).
         self.fused_steps = 0
         self.classic_steps = 0
-        # Per-phase wall timings of recent fused steps (bounded): where
-        # the FT tax goes — the commit barrier RPC, the program dispatch,
-        # and the fence readback. The fence entry is the interesting one
-        # on a remote-dispatch backend: it absorbs whatever device time
-        # step N-1 still needs, so fence >> barrier+dispatch means the
-        # host is NOT the bottleneck (the tax is device/transport time),
-        # while large dispatch means per-program host overhead.
-        from collections import deque
+        # Per-phase rolling timers of recent fused steps (the same
+        # Metrics facility the Manager uses, so one reset protocol covers
+        # a measurement window): where the FT tax goes — the commit
+        # barrier RPC, the program dispatch, and the fence readback. The
+        # fence entry is the interesting one on a remote-dispatch
+        # backend: it absorbs whatever device time step N-1 still needs,
+        # so fence >> barrier+dispatch means the host is NOT the
+        # bottleneck (the tax is device/transport time), while large
+        # dispatch means per-program host overhead.
+        from torchft_tpu.utils.metrics import Metrics
 
-        self.phase_ms = {
-            "barrier": deque(maxlen=512),
-            "dispatch": deque(maxlen=512),
-            "fence": deque(maxlen=512),
-        }
+        self.metrics = Metrics(window=512)
 
         def _update(grads, opt_state, params):
             updates, new_state = tx.update(grads, opt_state, params)
@@ -209,13 +207,10 @@ class OptimizerWrapper:
 
         Callers MUST check :meth:`can_fuse` after ``wait_quorum`` each
         step and use the grad/average/:meth:`step` path otherwise."""
-        import time as _time
-
         self.fused_steps += 1
-        _t0 = _time.perf_counter()
-        if self.manager.should_commit():
-            _t1 = _time.perf_counter()
-            self.phase_ms["barrier"].append((_t1 - _t0) * 1e3)
+        with self.metrics.timed("barrier"):
+            committed = self.manager.should_commit()
+        if committed:
             if self.manager.did_heal() and self._state_fn is not None:
                 # the barrier just loaded the donor snapshot; recompute on
                 # the healed pair, not the caller's stale references
@@ -225,15 +220,15 @@ class OptimizerWrapper:
                 # tree we are about to donate; wait it out while its
                 # buffers are still valid (block_until_ready on a donated
                 # buffer raises). Transition steps only — steady-state
-                # fused entries are loss scalars.
-                self._drain_fence()
-            params, opt_state, aux = fused_fn(params, opt_state, *args)
-            _t2 = _time.perf_counter()
-            self.phase_ms["dispatch"].append((_t2 - _t1) * 1e3)
-            self._push_fence("readback", aux)
-            self.phase_ms["fence"].append(
-                (_time.perf_counter() - _t2) * 1e3
-            )
+                # fused entries are loss scalars. Timed separately so a
+                # transition's device-scale wait can't masquerade as
+                # per-program dispatch overhead in the breakdown.
+                with self.metrics.timed("transition_drain"):
+                    self._drain_fence()
+            with self.metrics.timed("dispatch"):
+                params, opt_state, aux = fused_fn(params, opt_state, *args)
+            with self.metrics.timed("fence"):
+                self._push_fence("readback", aux)
             return params, opt_state, aux, True
         self._drain_fence()
         return params, opt_state, None, False
