@@ -347,6 +347,12 @@ def _devices_or_fallback() -> None:
                 },
                 code=2,
             )
+        # Salvaged: the child wrote a complete artifact before freezing.
+        # Its returncode is -9 (killed) or None (unkillable) — neither is
+        # a valid exit status and neither should taint a valid tail.
+        out = subprocess.CompletedProcess(
+            out.args, 0, stdout=out.stdout, stderr=out.stderr
+        )
     _forward_child_output(out)
 
 
@@ -786,10 +792,17 @@ def _run() -> None:
     t0_elapsed = time.perf_counter() - t_start
     profiler.close()
     t0 = tokens_per_step * steps / t0_elapsed
+    # T0's final (params, opt) are handed to T1 instead of deleted: the
+    # tunnel frees buffers LAZILY, so "del here, init_params there" held
+    # BOTH copies live long enough to RESOURCE_EXHAUST the 1b run at the
+    # T1 boundary (r3's missing 1b FT row). Reuse also skips a full
+    # re-init. Throughput is state-independent; starting T1 from trained
+    # weights changes nothing measured.
+    t1_initial_state = (p0, s0)
     del p0, s0
     import gc as _gc
 
-    _gc.collect()  # release T0 param/opt buffers before T1 allocates its own
+    _gc.collect()
     _PARTIAL.update(
         fault_free_tokens_per_sec=round(t0, 1),
         backend=backend, device_kind=device_kind, model=model_name,
@@ -826,8 +839,9 @@ def _run() -> None:
         heartbeat_timeout_ms=800,
     )
     store = StoreServer()
-    params_ft = init_params(cfg, key)
-    opt_state_holder = {"params": params_ft, "opt": tx.init(params_ft)}
+    params_ft, opt_init = t1_initial_state
+    del t1_initial_state
+    opt_state_holder = {"params": params_ft, "opt": opt_init}
 
     manager = Manager(
         comm=TcpCommContext(timeout=60.0),
@@ -916,12 +930,7 @@ def _run() -> None:
         # fixed cost). The moment a peer is on the wire (heals in on
         # CPU), the step falls back to grad → transport average → gated
         # update, unchanged.
-        try:
-            manager.wait_quorum()
-            fuse = opt.can_fuse()
-        except Exception:  # noqa: BLE001 — latched by the classic path
-            fuse = False
-        if fuse:
+        if opt.can_fuse():  # waits the quorum; latches on failure
             p, s, loss, ok = opt.fused_step(
                 step_fused, opt_state_holder["params"],
                 opt_state_holder["opt"], tokens, targets,

@@ -152,15 +152,7 @@ def main() -> None:
         while manager.current_step() < total_steps:
             tokens, targets = next_batch()
             opt.begin_step()
-            try:
-                manager.wait_quorum()
-                fuse = opt.can_fuse()
-            except Exception:  # noqa: BLE001 — whatever the quorum threw
-                # (timeout, malformed response, donor staging error), the
-                # classic path re-waits and LATCHES it so the step is
-                # discarded instead of crashing the loop
-                fuse = False
-            if fuse:
+            if opt.can_fuse():  # waits the quorum; latches on failure
                 new_params, new_opt, loss, committed = opt.fused_step(
                     fused_step, state["params"], state["opt"],
                     tokens, targets,

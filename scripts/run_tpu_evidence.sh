@@ -1,0 +1,49 @@
+#!/bin/bash
+# Round-4 on-chip evidence sequence. Run when the axon tunnel is healthy
+# (a probe subprocess proves it first — never hang the main claim).
+# Produces docs/evidence/bench_tpu_r4*.json artifacts:
+#   1. canonical 125m observer-peer run  -> bench_tpu_r4.json
+#      (target: vs_baseline >= 0.90 via the fused solo-wire commit,
+#       t1_phase_ms breakdown, measured flash_max_err)
+#   2. 1b row with FT + chaos columns    -> bench_tpu_r4_1b.json
+#      (donated fused path = no doubled params+opt HBM at T1)
+#   3. real data-plane peer chaos        -> bench_tpu_r4_chaos_peer.json
+#      (child heals onto the wire; kill exercises transport reconfigure
+#       + checkpoint streaming; t1_participants_max >= 2)
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p docs/evidence
+
+probe() {
+  timeout 240 python -c "import jax; print(jax.devices()[0].device_kind)" \
+    >/dev/null 2>&1
+}
+
+run_one() {
+  local name="$1"; shift
+  echo "=== $name ($(date +%H:%M:%S)) env: $*" >&2
+  env "$@" timeout 3000 python bench.py \
+    > "docs/evidence/${name}.stdout" 2> "docs/evidence/${name}.log"
+  tail -1 "docs/evidence/${name}.stdout" > "docs/evidence/${name}.json"
+  echo "--- ${name}: $(cut -c1-160 "docs/evidence/${name}.json")" >&2
+}
+
+if ! probe; then
+  echo "tunnel still wedged; aborting (no claim was made)" >&2
+  exit 1
+fi
+
+# 1. canonical 125m (defaults: 2 replicas, TPU parent -> observer child)
+run_one bench_tpu_r4 BENCH_NO_FALLBACK=1
+
+# 2. 1b fault-free + FT + chaos (adafactor fits opt state on one chip)
+run_one bench_tpu_r4_1b BENCH_NO_FALLBACK=1 BENCH_MODEL=1b \
+  BENCH_OPT=adafactor BENCH_BATCH=4 BENCH_SEQ=2048
+
+# 3. real data-plane peer: short seq the CPU child can sustain in
+# lockstep; chaos kill then hits a REAL wire member and the heal streams
+# real state (VERDICT r3 item 3)
+run_one bench_tpu_r4_chaos_peer BENCH_NO_FALLBACK=1 BENCH_MODEL=125m \
+  BENCH_SEQ=256 BENCH_BATCH=4 BENCH_CHILD_HEAL=1
+
+echo "all artifacts under docs/evidence/ — inspect before claiming" >&2

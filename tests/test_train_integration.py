@@ -384,3 +384,62 @@ def test_fused_step_drains_classic_fence_before_donation() -> None:
     assert ok
     # steady-state fused entries are loss scalars
     assert [k for k, _ in opt._in_flight] == ["readback"]
+
+
+def test_fused_trajectory_matches_classic() -> None:
+    # Correctness seal on the barrier-first fused protocol: over N
+    # committed steps, the fused one-program path must land where
+    # grad -> (identity average) -> gated update lands, to within XLA
+    # fusion rounding (the single fused program schedules ops differently
+    # than two programs -> ulp-level drift). A protocol-order or
+    # state-threading bug (stale params, skipped update, double apply)
+    # would diverge at the learning-rate scale, orders of magnitude
+    # above this tolerance.
+    tx = optax.adamw(1e-2)
+
+    def loss_fn(params, x):
+        return jnp.mean((x @ params["w"] - 1.0) ** 2)
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((4, 3)),
+                    jnp.float32)
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    @jax.jit
+    def update_fn(grads, state, params):
+        upd, state = tx.update(grads, state, params)
+        return optax.apply_updates(params, upd), state
+
+    @jax.jit
+    def fused_fn(params, state, x):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x)
+        upd, state = tx.update(grads, state, params)
+        return optax.apply_updates(params, upd), state, loss
+
+    init = {"w": jnp.asarray(
+        np.random.default_rng(1).standard_normal((3, 1)), jnp.float32)}
+
+    # classic path
+    mc = mock_manager(commit=True)
+    mc.did_heal.return_value = False
+    opt_c = OptimizerWrapper(mc, tx)
+    p_c, s_c = init, opt_c.init(init)
+    for _ in range(5):
+        _, grads = grad_fn(p_c, x)
+        p_c, s_c, ok = opt_c.step(p_c, s_c, grads)
+        assert ok
+
+    # fused path
+    mf = mock_manager(commit=True)
+    mf.did_heal.return_value = False
+    mf.is_solo_wire.return_value = True
+    opt_f = OptimizerWrapper(mf, tx)
+    p_f, s_f = init, opt_f.init(init)
+    for _ in range(5):
+        p_f, s_f, _, ok = opt_f.fused_step(fused_fn, p_f, s_f, x)
+        assert ok
+
+    np.testing.assert_allclose(
+        np.asarray(p_c["w"]), np.asarray(p_f["w"]),
+        rtol=1e-6, atol=1e-7,
+    )

@@ -159,11 +159,23 @@ class OptimizerWrapper:
             np.asarray(jax.device_get(value))
 
     def can_fuse(self) -> bool:
-        """True when THIS step's wire is solo (quorum already waited):
-        no data-plane peer means the cross-replica average is an identity,
-        so the whole step can run as one fused grad+update program via
-        :meth:`fused_step`. The quorum and commit barrier still run — they
-        are what detect rejoining peers and membership changes."""
+        """True when THIS step's wire is solo: no data-plane peer means
+        the cross-replica average is an identity, so the whole step can
+        run as one fused grad+update program via :meth:`fused_step`. The
+        quorum and commit barrier still run — they are what detect
+        rejoining peers and membership changes.
+
+        Waits the in-flight quorum itself; on quorum failure the error is
+        LATCHED (so the step is discarded by the commit gate) and False
+        is returned — callers just branch on the result, no try/except
+        needed. This keeps the "only after wait_quorum" contract
+        unbreakable instead of conventional."""
+        try:
+            self.manager.wait_quorum()
+        except Exception as e:  # noqa: BLE001 — timeout, malformed
+            # response, donor staging error: all mean "no fused step"
+            self.manager.report_error(e)
+            return False
         return self.manager.is_solo_wire()
 
     def fused_step(
@@ -205,8 +217,9 @@ class OptimizerWrapper:
         loop (as the bench's T0 does) to keep first-compile failures out
         of the window.
 
-        Callers MUST check :meth:`can_fuse` after ``wait_quorum`` each
-        step and use the grad/average/:meth:`step` path otherwise."""
+        Callers MUST branch on :meth:`can_fuse` each step (it waits the
+        quorum itself) and use the grad/average/:meth:`step` path when it
+        returns False."""
         self.fused_steps += 1
         with self.metrics.timed("barrier"):
             committed = self.manager.should_commit()
