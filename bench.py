@@ -864,6 +864,7 @@ def _run() -> None:
             opt_state_holder["params"], opt_state_holder["opt"],
         ),
         fence_depth=int(os.environ.get("BENCH_FENCE_DEPTH", "1")),
+        fence_stride=int(os.environ.get("BENCH_FENCE_STRIDE", "8")),
     )
 
     children: "list[subprocess.Popen]" = []

@@ -443,3 +443,65 @@ def test_fused_trajectory_matches_classic() -> None:
         np.asarray(p_c["w"]), np.asarray(p_f["w"]),
         rtol=1e-6, atol=1e-7,
     )
+
+
+def test_fused_fence_stride_batches_readbacks() -> None:
+    # The fused fence drains ready loss scalars `fence_stride` at a time
+    # in one batched device_get (RTT/stride per step on a remote-dispatch
+    # backend) and bounds host lead at fence_depth + fence_stride.
+    manager = mock_manager(commit=True)
+    manager.errored.return_value = None
+    manager.is_participating.return_value = True
+    manager.did_heal.return_value = False
+    manager.is_solo_wire.return_value = True
+    tx = optax.sgd(0.1)
+    opt = OptimizerWrapper(manager, tx, fence_depth=1, fence_stride=4)
+
+    def fused(p, s, i):
+        return p, s, jnp.float32(i)
+
+    p, s = {"w": jnp.ones(2)}, opt.init({"w": jnp.ones(2)})
+    lengths = []
+    for i in range(12):
+        p, s, _, ok = opt.fused_step(fused, p, s, i)
+        assert ok
+        lengths.append(len(opt._in_flight))
+    # lead never exceeds depth + stride; a batch drain actually happened
+    assert max(lengths) <= 1 + 4
+    assert min(lengths[4:]) >= 1  # depth entries are retained
+    assert any(
+        lengths[i + 1] < lengths[i] for i in range(len(lengths) - 1)
+    ), "no batch drain ever happened"
+
+    # non-commit drains everything in one batch
+    manager.should_commit.return_value = False
+    p, s, aux, ok = opt.fused_step(fused, p, s, 99)
+    assert not ok and opt._in_flight == []
+
+
+def test_fused_to_classic_transition_shrinks_fence() -> None:
+    # A peer rejoining mid-run flips the loop from fused to classic; the
+    # classic fence must drain the fused path's widened readback window
+    # back down to fence_depth instead of pinning fence_stride params
+    # trees in HBM forever.
+    manager = mock_manager(commit=True)
+    manager.errored.return_value = None
+    manager.is_participating.return_value = True
+    manager.did_heal.return_value = False
+    manager.is_solo_wire.return_value = True
+    tx = optax.sgd(0.1)
+    opt = OptimizerWrapper(manager, tx, fence_depth=1, fence_stride=8)
+
+    def fused(p, s, i):
+        return p, s, jnp.float32(i)
+
+    p, s = {"w": jnp.ones(2)}, opt.init({"w": jnp.ones(2)})
+    for i in range(8):  # widen the window (no batch drain yet)
+        p, s, _, _ = opt.fused_step(fused, p, s, i)
+    assert len(opt._in_flight) == 8
+
+    # peer rejoins: classic path takes over with committing steps
+    p, s, ok = opt.step(p, s, {"w": jnp.full(2, 2.0)})
+    assert ok
+    assert len(opt._in_flight) == opt._fence_depth == 1
+    assert [k for k, _ in opt._in_flight] == ["block"]
