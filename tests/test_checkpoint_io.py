@@ -162,3 +162,35 @@ def test_step_checkpoints_ignore_foreign_families(tmp_path) -> None:
     assert latest_checkpoint(base).endswith("run.ckpt.10")
     names = sorted(os.listdir(tmp_path))
     assert "run.ckpt.ema.50" in names and "run.ckpt.backup.2" in names
+
+
+def test_orbax_checkpointer_roundtrip_and_keep(tmp_path):
+    import numpy as np
+    import pytest
+
+    pytest.importorskip("orbax.checkpoint")
+    from torchft_tpu.checkpoint_io import OrbaxCheckpointer
+
+    state = {
+        "user": {"params": {"w": np.arange(6, dtype=np.float32)}},
+        "manager": {"step": 3, "batches_committed": 7},
+    }
+    with OrbaxCheckpointer(str(tmp_path / "ckpt"), keep=2) as ck:
+        for s in (1, 2, 3):
+            st = dict(state)
+            st["manager"] = {"step": s, "batches_committed": 7}
+            ck.save_step(s, st)
+        ck.wait()
+        assert ck.latest_step() == 3
+        restored = ck.restore()
+        np.testing.assert_array_equal(
+            restored["user"]["params"]["w"], state["user"]["params"]["w"]
+        )
+        assert int(restored["manager"]["step"]) == 3
+        # keep=2: step 1 pruned
+        with OrbaxCheckpointer(str(tmp_path / "ckpt"), keep=2) as ck2:
+            assert ck2.latest_step() == 3
+            steps = {1, 2, 3} & set(
+                ck2._manager.all_steps()
+            )
+            assert steps == {2, 3}

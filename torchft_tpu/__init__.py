@@ -15,6 +15,7 @@ __version__ = "0.1.0"
 
 from torchft_tpu.checkpoint_io import (  # noqa: F401
     AsyncCheckpointWriter,
+    OrbaxCheckpointer,
     load_checkpoint,
 )
 from torchft_tpu.checkpointing import (  # noqa: F401
@@ -47,6 +48,7 @@ from torchft_tpu.optim import OptimizerWrapper  # noqa: F401
 
 __all__ = [
     "AsyncCheckpointWriter",
+    "OrbaxCheckpointer",
     "CheckpointServer",
     "CheckpointTransport",
     "CommContext",

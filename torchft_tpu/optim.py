@@ -227,10 +227,12 @@ class OptimizerWrapper:
         The fence differs from :meth:`step`: donated-buffer chains are
         exactly the case where ``block_until_ready`` has been observed
         returning early on the TPU tunnel (bench.py ``_sync`` rationale),
-        so the fence here is a scalar ``device_get`` of the loss from
-        ``fence_depth`` steps ago — one guaranteed-complete readback per
-        step, and completion of any output of an XLA execution implies
-        the whole execution (the donated params update included) ran.
+        so the fence here is a ``device_get`` of delayed loss scalars —
+        batched ``fence_stride`` at a time (one guaranteed-complete
+        transfer per stride; host lead bounded by fence_depth +
+        fence_stride), and completion of any output of an XLA execution
+        implies the whole execution (the donated params update
+        included) ran.
 
         Failure-after-vote window: the barrier advances step and
         batches_committed BEFORE the fused compute is dispatched, so a
