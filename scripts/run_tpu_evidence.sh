@@ -15,8 +15,20 @@ cd "$(dirname "$0")/.."
 mkdir -p docs/evidence
 
 probe() {
-  timeout 240 python -c "import jax; print(jax.devices()[0].device_kind)" \
-    >/dev/null 2>&1
+  # NEVER kill a probing process: a SIGTERM mid-backend-claim is what
+  # creates the stale single-tenant claim that wedges the tunnel for
+  # every later claimant. Poll and ABANDON a hung probe instead.
+  rm -f /tmp/_evidence_probe_ok
+  python -c "
+import jax
+if 'cpu' not in str(jax.devices()[0].device_kind).lower():
+    open('/tmp/_evidence_probe_ok','w').write('ok')
+" >/dev/null 2>&1 &
+  local pid=$! waited=0
+  while kill -0 "$pid" 2>/dev/null && [ "$waited" -lt 240 ]; do
+    sleep 5; waited=$((waited + 5))
+  done
+  [ -f /tmp/_evidence_probe_ok ]
 }
 
 run_one() {
