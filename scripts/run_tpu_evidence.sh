@@ -17,18 +17,21 @@ mkdir -p docs/evidence
 probe() {
   # NEVER kill a probing process: a SIGTERM mid-backend-claim is what
   # creates the stale single-tenant claim that wedges the tunnel for
-  # every later claimant. Poll and ABANDON a hung probe instead.
-  rm -f /tmp/_evidence_probe_ok
-  python -c "
-import jax
+  # every later claimant. Poll and ABANDON a hung probe instead. The
+  # success sentinel is per-invocation (an abandoned probe from an
+  # earlier run writing a fixed path later would fake "healthy").
+  local ok
+  ok="$(mktemp /tmp/evidence_probe_ok.XXXXXX.d)" && rm -f "$ok"
+  PROBE_OK_PATH="$ok" python -c "
+import os, jax
 if 'cpu' not in str(jax.devices()[0].device_kind).lower():
-    open('/tmp/_evidence_probe_ok','w').write('ok')
+    open(os.environ['PROBE_OK_PATH'], 'w').write('ok')
 " >/dev/null 2>&1 &
   local pid=$! waited=0
   while kill -0 "$pid" 2>/dev/null && [ "$waited" -lt 240 ]; do
     sleep 5; waited=$((waited + 5))
   done
-  [ -f /tmp/_evidence_probe_ok ]
+  [ -f "$ok" ]
 }
 
 run_one() {

@@ -108,10 +108,12 @@ def test_lighthouse_cli_starts_and_serves() -> None:
         proc.wait(timeout=10)
 
 
-def test_train_hsdp_example_runs() -> None:
-    # The HSDP example (fsdp/tp-sharded group + sharded-heal transport)
-    # must train end-to-end as a real subprocess against a real
-    # lighthouse — the apps-level seal on the sharded composition.
+
+
+def _run_example(script, extra_env, timeout=180):
+    """Run an examples/ script as a real subprocess against a fresh
+    in-process lighthouse (the shared shape of every example-runner
+    test): CPU jax, axon sitecustomize dropped, repo-root cwd."""
     import os
 
     from torchft_tpu.control import Lighthouse
@@ -120,22 +122,31 @@ def test_train_hsdp_example_runs() -> None:
     env = dict(os.environ)
     env.update(
         TORCHFT_TPU_LIGHTHOUSE=lh.address(),
-        TOTAL_STEPS="3",
         REPLICA_GROUP_ID="0",
         LOGLEVEL="ERROR",
         JAX_PLATFORMS="cpu",
+        **extra_env,
     )
     env.pop("PYTHONPATH", None)  # drop the axon sitecustomize
     try:
-        proc = subprocess.run(
-            [sys.executable, "examples/train_hsdp.py"],
-            env=env, capture_output=True, text=True, timeout=120,
+        return subprocess.run(
+            [sys.executable, script],
+            env=env, capture_output=True, text=True, timeout=timeout,
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         )
-        assert proc.returncode == 0, proc.stderr[-2000:]
-        assert "step 3" in proc.stdout, proc.stdout
     finally:
         lh.shutdown()
+
+
+def test_train_hsdp_example_runs() -> None:
+    # The HSDP example (fsdp/tp-sharded group + sharded-heal transport)
+    # must train end-to-end as a real subprocess against a real
+    # lighthouse — the apps-level seal on the sharded composition.
+    proc = _run_example(
+        "examples/train_hsdp.py", {"TOTAL_STEPS": "3"}, timeout=120
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "step 3" in proc.stdout, proc.stdout
 
 
 def test_train_ddp_example_durable_resume(tmp_path) -> None:
@@ -148,29 +159,17 @@ def test_train_ddp_example_durable_resume(tmp_path) -> None:
     from torchft_tpu.control import Lighthouse
 
     ckpt = str(tmp_path / "ddp.ckpt")
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
     def run(total_steps: int):
-        lh = Lighthouse(min_replicas=1, join_timeout_ms=200)
-        env = dict(os.environ)
-        env.update(
-            TORCHFT_TPU_LIGHTHOUSE=lh.address(),
-            TOTAL_STEPS=str(total_steps),
-            NUM_REPLICA_GROUPS="1",
-            REPLICA_GROUP_ID="0",
-            CKPT_PATH=ckpt,
-            LOGLEVEL="ERROR",
-            JAX_PLATFORMS="cpu",
+        return _run_example(
+            "examples/train_ddp.py",
+            {
+                "TOTAL_STEPS": str(total_steps),
+                "NUM_REPLICA_GROUPS": "1",
+                "CKPT_PATH": ckpt,
+            },
+            timeout=120,
         )
-        env.pop("PYTHONPATH", None)  # drop the axon sitecustomize
-        try:
-            return subprocess.run(
-                [sys.executable, "examples/train_ddp.py"],
-                env=env, capture_output=True, text=True, timeout=120,
-                cwd=repo,
-            )
-        finally:
-            lh.shutdown()
 
     first = run(10)
     assert first.returncode == 0, first.stderr[-2000:]
@@ -191,60 +190,47 @@ def test_train_llama_ring_example_runs() -> None:
     # Llama (GQA/RoPE/SwiGLU) x ring attention (sequence parallelism)
     # x chunked CE x FT manager, end-to-end as a real subprocess — the
     # apps-level seal on the long-context composition.
-    import os
-
-    from torchft_tpu.control import Lighthouse
-
-    lh = Lighthouse(min_replicas=1, join_timeout_ms=200)
-    env = dict(os.environ)
-    env.update(
-        TORCHFT_TPU_LIGHTHOUSE=lh.address(),
-        TOTAL_STEPS="3",
-        REPLICA_GROUP_ID="0",
-        SEQ_LEN="128",
-        LOGLEVEL="ERROR",
-        JAX_PLATFORMS="cpu",
-        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+    proc = _run_example(
+        "examples/train_llama_ring.py",
+        {
+            "TOTAL_STEPS": "3",
+            "SEQ_LEN": "128",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        },
     )
-    env.pop("PYTHONPATH", None)  # drop the axon sitecustomize
-    try:
-        proc = subprocess.run(
-            [sys.executable, "examples/train_llama_ring.py"],
-            env=env, capture_output=True, text=True, timeout=180,
-            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        )
-        assert proc.returncode == 0, proc.stderr[-2000:]
-        assert "step 3" in proc.stdout, proc.stdout
-    finally:
-        lh.shutdown()
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "step 3" in proc.stdout, proc.stdout
 
 
 def test_train_moe_example_runs() -> None:
     # MoE transformer (expert-parallel GShard FFN on an ``expert`` mesh
     # axis) x FT manager loop, end-to-end as a real subprocess — the
     # apps-level seal on the expert-parallel composition.
-    import os
-
-    from torchft_tpu.control import Lighthouse
-
-    lh = Lighthouse(min_replicas=1, join_timeout_ms=200)
-    env = dict(os.environ)
-    env.update(
-        TORCHFT_TPU_LIGHTHOUSE=lh.address(),
-        TOTAL_STEPS="3",
-        REPLICA_GROUP_ID="0",
-        LOGLEVEL="ERROR",
-        JAX_PLATFORMS="cpu",
-        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+    proc = _run_example(
+        "examples/train_moe.py",
+        {
+            "TOTAL_STEPS": "3",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        },
     )
-    env.pop("PYTHONPATH", None)  # drop the axon sitecustomize
-    try:
-        proc = subprocess.run(
-            [sys.executable, "examples/train_moe.py"],
-            env=env, capture_output=True, text=True, timeout=180,
-            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        )
-        assert proc.returncode == 0, proc.stderr[-2000:]
-        assert "step 3" in proc.stdout, proc.stdout
-    finally:
-        lh.shutdown()
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "step 3" in proc.stdout, proc.stdout
+
+
+def test_train_diloco_example_runs() -> None:
+    # DiLoCo (outer-optimizer DP, sync quorum, pseudogradient averaging)
+    # end-to-end as a real subprocess — the apps-level seal on the
+    # infrequent-sync composition (the one example previously without an
+    # app-level test).
+    proc = _run_example(
+        "examples/train_diloco.py",
+        {
+            "TOTAL_SYNCS": "2",
+            "SYNC_EVERY": "2",
+            "NUM_REPLICA_GROUPS": "1",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        },
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "sync committed" in proc.stdout, proc.stdout
+    assert "done after" in proc.stdout, proc.stdout
