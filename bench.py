@@ -563,8 +563,18 @@ def _child_main() -> None:
 
     cfg = CONFIGS[model_name]
     key = jax.random.key(1000 + idx)
-    params = init_params(cfg, key)
     tx = _make_tx(optax)
+    if sync_grads:
+        params = init_params(cfg, key)
+    else:
+        # Observer child: never on the wire, never a donor (the quorum
+        # kernel excludes observers from donor election), never trains —
+        # its params are pure bring-up cost. At 1b a full CPU init takes
+        # long enough to blow the parent's 90s bring-up deadline (the
+        # chaos phase then silently downgrades to solo — r3's 1b row had
+        # no chaos columns). A tiny placeholder keeps the control-plane
+        # traffic identical at zero init cost.
+        params = init_params(CONFIGS["tiny"], key)
     holder = {"params": params, "opt": tx.init(params)}
 
     if sync_grads:

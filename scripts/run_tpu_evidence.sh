@@ -31,7 +31,10 @@ if 'cpu' not in str(jax.devices()[0].device_kind).lower():
   while kill -0 "$pid" 2>/dev/null && [ "$waited" -lt 240 ]; do
     sleep 5; waited=$((waited + 5))
   done
-  [ -f "$ok" ]
+  local rc=1
+  [ -f "$ok" ] && rc=0
+  rm -f "$ok"
+  return "$rc"
 }
 
 run_one() {
