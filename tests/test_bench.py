@@ -80,6 +80,14 @@ def test_bench_tail_is_json_through_chaos_teardown():
     # on CPU the child heals into the cohort: T1 must have measured REAL
     # 2-participant averaging, not an idle echo
     assert payload["t1_participants_max"] == 2
+    # ...and the path counters must prove it: a 2-member wire rides the
+    # classic grad/transport/update path, not the solo fused program
+    assert payload["t1_classic_steps"] >= 1
+    # the chaos window spans both: classic while the peer lives, fused
+    # after the kill leaves the survivor solo (the 2.5s dead time past
+    # the 800ms heartbeat guarantees solo steps)
+    assert payload["chaos_classic_steps"] >= 1
+    assert payload["chaos_fused_steps"] >= 1
 
 
 def test_bench_solo_tail_is_json():
