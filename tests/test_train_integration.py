@@ -286,7 +286,7 @@ def test_ddp_buckets_issue_pipelined() -> None:
     grads = {
         "a": jnp.arange(32, dtype=jnp.float32),
         "b": jnp.ones(32, dtype=jnp.float32),
-        "c": jnp.ones(32, dtype=jnp.float64),
+        "c": jnp.ones(32, dtype=jnp.bfloat16),  # distinct dtype bucket
     }
     t0 = time.perf_counter()
     out = ddp.average_gradients(grads)
