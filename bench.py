@@ -1271,7 +1271,9 @@ def _run() -> None:
             # 2-replica CPU runs share these cores between both trainers;
             # vs_baseline on a 1-core host is dominated by that contention
             # (a sandbox artifact — on TPU the replicas own separate chips)
-            "host_cores": len(os.sched_getaffinity(0)),
+            "host_cores": (len(os.sched_getaffinity(0))
+                           if hasattr(os, "sched_getaffinity")
+                           else (os.cpu_count() or 1)),
         }
     )
 

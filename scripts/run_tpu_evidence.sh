@@ -1,13 +1,13 @@
 #!/bin/bash
-# Round-4 on-chip evidence sequence. Run when the axon tunnel is healthy
+# Round-5 on-chip evidence sequence. Run when the axon tunnel is healthy
 # (a probe subprocess proves it first — never hang the main claim).
-# Produces docs/evidence/bench_tpu_r4*.json artifacts:
-#   1. canonical 125m observer-peer run  -> bench_tpu_r4.json
+# Produces docs/evidence/bench_tpu_r5*.json artifacts:
+#   1. canonical 125m observer-peer run  -> bench_tpu_r5.json
 #      (target: vs_baseline >= 0.90 via the fused solo-wire commit,
 #       t1_phase_ms breakdown, measured flash_max_err)
-#   2. 1b row with FT + chaos columns    -> bench_tpu_r4_1b.json
+#   2. 1b row with FT + chaos columns    -> bench_tpu_r5_1b.json
 #      (donated fused path = no doubled params+opt HBM at T1)
-#   3. real data-plane peer chaos        -> bench_tpu_r4_chaos_peer.json
+#   3. real data-plane peer chaos        -> bench_tpu_r5_chaos_peer.json
 #      (child heals onto the wire; kill exercises transport reconfigure
 #       + checkpoint streaming; t1_participants_max >= 2)
 set -u
@@ -38,12 +38,26 @@ if 'cpu' not in str(jax.devices()[0].device_kind).lower():
 }
 
 run_one() {
+  # No shell `timeout` here: SIGTERMing bench.py mid-TPU-claim is the
+  # kill-mid-claim hazard probe() warns about, and a killed bench emits
+  # no JSON tail. The bench's internal watchdog emits a parseable
+  # bench_error line and exits on its own on overrun.
   local name="$1"; shift
   echo "=== $name ($(date +%H:%M:%S)) env: $*" >&2
-  env "$@" timeout 3000 python bench.py \
+  # A stale .json from an earlier invocation must never be attributed to
+  # this run — drop it before the bench starts.
+  rm -f "docs/evidence/${name}.json"
+  env "$@" python bench.py \
     > "docs/evidence/${name}.stdout" 2> "docs/evidence/${name}.log"
-  tail -1 "docs/evidence/${name}.stdout" > "docs/evidence/${name}.json"
-  echo "--- ${name}: $(cut -c1-160 "docs/evidence/${name}.json")" >&2
+  local tail_line
+  tail_line="$(tail -1 "docs/evidence/${name}.stdout")"
+  if printf '%s' "$tail_line" | python -c 'import json,sys; json.load(sys.stdin)' 2>/dev/null; then
+    printf '%s\n' "$tail_line" > "docs/evidence/${name}.json"
+    echo "--- ${name}: $(cut -c1-160 "docs/evidence/${name}.json")" >&2
+  else
+    echo "--- ${name}: tail is NOT JSON; refusing to record it as an artifact" >&2
+    printf '%s\n' "$tail_line" > "docs/evidence/${name}.badtail"
+  fi
 }
 
 if ! probe; then
@@ -52,17 +66,17 @@ if ! probe; then
 fi
 
 # 1. canonical 125m (defaults: 2 replicas, TPU parent -> observer child)
-run_one bench_tpu_r4 BENCH_NO_FALLBACK=1
+run_one bench_tpu_r5 BENCH_NO_FALLBACK=1
 
 # 2. 1b fault-free + FT + chaos (adafactor fits opt state on one chip)
-run_one bench_tpu_r4_1b BENCH_NO_FALLBACK=1 BENCH_MODEL=1b \
+run_one bench_tpu_r5_1b BENCH_NO_FALLBACK=1 BENCH_MODEL=1b \
   BENCH_OPT=adafactor BENCH_BATCH=4 BENCH_SEQ=2048
 
 # 3. real data-plane peer: a model the 1-core CPU child can sustain in
 # lockstep (tiny ~0.1s/step; 125m would be ~15s/step on one core — the
 # wire waits on the slowest member). The chaos kill then hits a REAL
 # wire member and the heal streams real state (VERDICT r3 item 3).
-run_one bench_tpu_r4_chaos_peer BENCH_NO_FALLBACK=1 BENCH_MODEL=tiny \
+run_one bench_tpu_r5_chaos_peer BENCH_NO_FALLBACK=1 BENCH_MODEL=tiny \
   BENCH_CHILD_HEAL=1 BENCH_CHILD_SYNC=1
 
 echo "all artifacts under docs/evidence/ — inspect before claiming" >&2
