@@ -178,6 +178,7 @@ void* ft_manager_client_new(const char* addr, uint64_t connect_timeout_ms,
 char* ft_manager_client_quorum(void* handle, int64_t rank, int64_t step,
                                const char* checkpoint_metadata,
                                int shrink_only, int data_plane,
+                               int64_t comm_epoch,
                                uint64_t timeout_ms, char** err) {
   auto* c = static_cast<ClientHandle*>(handle);
   ftjson::Object req;
@@ -186,6 +187,7 @@ char* ft_manager_client_quorum(void* handle, int64_t rank, int64_t step,
   req["checkpoint_metadata"] = std::string(checkpoint_metadata);
   req["shrink_only"] = shrink_only != 0;
   req["data_plane"] = data_plane != 0;
+  req["comm_epoch"] = comm_epoch;
   std::string out;
   if (!client_post(c, "/torchft.ManagerService/Quorum",
                    ftjson::Value(req).dump(),

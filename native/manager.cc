@@ -93,6 +93,7 @@ Response ManagerServer::handle_quorum(const Request& req) {
   std::string ckpt_meta;
   bool shrink_only;
   bool data_plane = true;
+  int64_t comm_epoch = 0;
   try {
     auto body = ftjson::Value::parse(req.body);
     rank = body.get_int("rank");
@@ -100,6 +101,7 @@ Response ManagerServer::handle_quorum(const Request& req) {
     ckpt_meta = body.get_str("checkpoint_metadata");
     shrink_only = body.get_bool("shrink_only");
     data_plane = body.get_bool("data_plane", true);
+    comm_epoch = body.get_int("comm_epoch", 0);
   } catch (const std::exception& e) {
     return Response{400, "application/json",
                     std::string("{\"error\":\"") + e.what() + "\"}"};
@@ -107,6 +109,7 @@ Response ManagerServer::handle_quorum(const Request& req) {
 
   std::unique_lock<std::mutex> lk(mu_);
   checkpoint_metadata_[rank] = ckpt_meta;
+  comm_epochs_[rank] = comm_epoch;
   participants_.insert(rank);
   uint64_t seen = quorum_seq_;
 
@@ -125,6 +128,9 @@ Response ManagerServer::handle_quorum(const Request& req) {
     self.world_size = opts_.world_size;
     self.shrink_only = shrink_only;
     self.data_plane = data_plane;
+    for (const auto& kv : comm_epochs_) {
+      self.comm_epoch = std::max(self.comm_epoch, kv.second);
+    }
 
     lk.unlock();
     std::string host;

@@ -76,6 +76,9 @@ class ManagerServer {
   // Quorum fan-in state.
   std::map<int64_t, std::string> checkpoint_metadata_;
   std::set<int64_t> participants_;
+  // Per-rank data-plane incarnations; the group's Member carries the max
+  // (any rank's latched transport must force the coordinated reconfigure).
+  std::map<int64_t, int64_t> comm_epochs_;
   uint64_t quorum_seq_ = 0;
   std::optional<ftquorum::QuorumInfo> latest_quorum_;
 

@@ -16,6 +16,7 @@ ftjson::Value Member::to_json() const {
   o["world_size"] = static_cast<int64_t>(world_size);
   o["shrink_only"] = shrink_only;
   o["data_plane"] = data_plane;
+  o["comm_epoch"] = comm_epoch;
   return ftjson::Value(std::move(o));
 }
 
@@ -28,6 +29,7 @@ Member Member::from_json(const ftjson::Value& v) {
   m.world_size = static_cast<uint64_t>(v.get_int("world_size", 1));
   m.shrink_only = v.get_bool("shrink_only");
   m.data_plane = v.get_bool("data_plane", true);
+  m.comm_epoch = v.get_int("comm_epoch", 0);
   return m;
 }
 
@@ -56,6 +58,10 @@ bool quorum_changed(const std::vector<Member>& a,
   if (a.size() != b.size()) return true;
   for (size_t i = 0; i < a.size(); i++) {
     if (a[i].replica_id != b[i].replica_id) return true;
+    // A bumped data-plane incarnation is a membership change for
+    // transport purposes: the fresh quorum_id it forces is what makes
+    // every wire member reconfigure together (see Member::comm_epoch).
+    if (a[i].comm_epoch != b[i].comm_epoch) return true;
   }
   return false;
 }

@@ -30,6 +30,16 @@ struct Member {
   // echo replicas on a host that cannot absorb the wire). Data-plane
   // members must never wait on an observer's transport.
   bool data_plane = true;
+  // Monotonic per-replica data-plane incarnation. A replica bumps this
+  // when its transport latched an error that membership change alone
+  // would not clear (e.g. a timed-out collective with a stable quorum):
+  // any epoch change makes quorum_changed() true, so the lighthouse
+  // issues a fresh quorum_id and EVERY wire member reconfigures onto a
+  // fresh rendezvous prefix together — the coordinated recovery a
+  // member-local reconfigure cannot achieve. (The reference gets the
+  // equivalent only via process restart: a relaunched replica's changed
+  // address bumps its quorum, ref lighthouse.rs:272-283.)
+  int64_t comm_epoch = 0;
 
   ftjson::Value to_json() const;
   static Member from_json(const ftjson::Value& v);

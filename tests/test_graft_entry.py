@@ -15,6 +15,9 @@ def test_dryrun_multichip_8(capsys) -> None:
     dryrun_multichip(8)
     tail = capsys.readouterr().out.strip().splitlines()[-1]
     assert "OK" in tail
-    # the FT segment actually ran: groups, a heal, and common steps
-    assert "ft[groups=2x4dev" in tail
-    assert "heals=" in tail and "heals=0" not in tail
+    # the FT segment actually ran at the r5 topology: 2-rank groups +
+    # spare + observer, per-rank heals, spare park/promote transitions
+    assert "ft[groups=3x2rx2dev" in tail
+    assert "observer=1" in tail
+    assert "heals=" in tail and "heals=0" not in tail and "heals=1 " not in tail
+    assert "parked=0" not in tail and "promoted=0" not in tail

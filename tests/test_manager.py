@@ -667,3 +667,96 @@ def test_observer_start_quorum_forces_allow_heal_false(store) -> None:
     assert manager._pending_state_dict is None
     assert not manager.is_participating()
     manager.shutdown(wait=False)
+
+
+# ------------------------------------------------- overlappable commit barrier
+
+
+def test_should_commit_async_overlaps_rpc(store) -> None:
+    """The barrier RPC rides a background thread while the caller's
+    thread is free (to dispatch the update program); counters move only
+    with the decision — the overlap can never make a step count as
+    committed before the quorum agreed."""
+    manager, client, comm, _ = make_manager(store)
+    client.quorum.return_value = quorum_result()
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def slow_commit(rank, step, should_commit, timeout=None):
+        entered.set()
+        assert gate.wait(5)
+        return True
+
+    client.should_commit.side_effect = slow_commit
+    manager.start_quorum()
+    manager.wait_quorum()
+    fut = manager.should_commit_async()
+    assert fut.local_should_commit is True
+    # the RPC is mid-flight on the executor; this thread is free — the
+    # exact window the optimizer uses to dispatch the update program
+    assert entered.wait(5)
+    assert not fut.done()
+    assert manager.current_step() == 0
+    gate.set()
+    assert fut.result(timeout=5) is True
+    assert manager.current_step() == 1
+    assert manager.batches_committed() == 2
+    manager.shutdown(wait=False)
+
+
+def test_should_commit_async_false_local_vote_after_error(store) -> None:
+    """A latched transport error is visible on the returned future BEFORE
+    the decision resolves, so callers skip the optimistic dispatch."""
+    manager, client, comm, _ = make_manager(store)
+    client.quorum.return_value = quorum_result()
+    client.should_commit.return_value = False
+    manager.start_quorum()
+    manager.wait_quorum()
+    manager.report_error(RuntimeError("transport died"))
+    fut = manager.should_commit_async()
+    assert fut.local_should_commit is False
+    assert fut.result(timeout=5) is False
+    assert manager.current_step() == 0
+    manager.shutdown(wait=False)
+
+
+def test_should_commit_async_applies_heal_in_prologue(store) -> None:
+    """The pending heal must be applied synchronously in the prologue —
+    before the future is returned — so an overlapping caller dispatches
+    its update against the HEALED state, never the stale pair."""
+    donor_server = CheckpointServer(timeout=5.0)
+    donor_server.allow_checkpoint(
+        20,
+        {
+            "user": {"w": np.full(2, 7.0)},
+            "torchft": {"step": 20, "batches_committed": 40},
+        },
+    )
+    manager, client, comm, state = make_manager(store)
+    client.quorum.return_value = quorum_result(
+        quorum_id=3,
+        replica_rank=1,
+        replica_world_size=2,
+        recover_src_rank=0,
+        recover_src_manager_address="http://donor:1",
+        max_step=20,
+        max_rank=None,
+        max_world_size=1,
+        heal=True,
+    )
+    client.should_commit.return_value = True
+    with patch("torchft_tpu.manager.ManagerClient") as heal_client_cls:
+        heal_client_cls.return_value.checkpoint_metadata.return_value = (
+            donor_server.address()
+        )
+        manager.start_quorum()
+        manager.wait_quorum()
+    fut = manager.should_commit_async()
+    # healed state is already applied when the prologue returns, even
+    # though the decision may still be in flight
+    assert manager.did_heal()
+    np.testing.assert_allclose(state["w"], np.full(2, 7.0))
+    assert fut.result(timeout=5) is True
+    assert manager.current_step() == 21
+    donor_server.shutdown()
+    manager.shutdown(wait=False)

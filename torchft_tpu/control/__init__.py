@@ -227,6 +227,7 @@ class ManagerClient:
         shrink_only: bool,
         timeout: "float | timedelta",
         data_plane: bool = True,
+        comm_epoch: int = 0,
     ) -> QuorumResult:
         err = ctypes.c_char_p()
         ptr = get_lib().ft_manager_client_quorum(
@@ -236,6 +237,7 @@ class ManagerClient:
             checkpoint_metadata.encode(),
             1 if shrink_only else 0,
             1 if data_plane else 0,
+            comm_epoch,
             _ms(timeout),
             ctypes.byref(err),
         )

@@ -32,6 +32,8 @@ class ManagerClient:
         checkpoint_metadata: str,
         shrink_only: bool,
         timeout: "float | timedelta",
+        data_plane: bool = ...,
+        comm_epoch: int = ...,
     ) -> QuorumResult: ...
     def checkpoint_metadata(
         self, rank: int, timeout: "float | timedelta"

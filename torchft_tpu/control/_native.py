@@ -87,7 +87,7 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.ft_manager_client_new.argtypes = [c_char_p, c_u64, err_p]
     lib.ft_manager_client_new.restype = c_void_p
     lib.ft_manager_client_quorum.argtypes = [
-        c_void_p, c_i64, c_i64, c_char_p, c_int, c_int, c_u64, err_p,
+        c_void_p, c_i64, c_i64, c_char_p, c_int, c_int, c_i64, c_u64, err_p,
     ]
     lib.ft_manager_client_quorum.restype = c_void_p
     lib.ft_manager_client_checkpoint_metadata.argtypes = [

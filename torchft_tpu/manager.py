@@ -206,8 +206,21 @@ class Manager:
         # (quorum_id, wire-membership fingerprint, in_transport) of the
         # last successful comm.configure — the transport reconfigures
         # exactly when this changes (quorum membership change, data-plane
-        # opt-out set change).
+        # opt-out set change, or any member's comm_epoch bump — the bump
+        # forces a fresh quorum_id, see below).
         self._transport_key: "Optional[tuple]" = None
+        # Data-plane incarnation sent with every quorum request. Bumped
+        # when our transport latched an error that membership change
+        # alone would not clear (a timed-out collective under a STABLE
+        # quorum): a latched TcpCommContext fails every op until
+        # configure(), and configure only runs on a transport-key change,
+        # so without the bump one transient wire fault would poison the
+        # peers forever. The lighthouse treats any epoch change as a
+        # membership change (native/quorum.cc quorum_changed), issuing a
+        # fresh quorum_id — so ALL wire members reconfigure onto a fresh
+        # rendezvous prefix together, rather than one member redialing a
+        # cohort that kept its old sockets.
+        self._comm_epoch = 0
         self._transport_world_size = 1
         self._errored: Optional[Exception] = None
         self._errored_lock = threading.Lock()
@@ -390,6 +403,18 @@ class Manager:
         self._healing = False
         self._did_heal = False
 
+        if self._comm.errored() is not None:
+            # Latched transport: request a coordinated reconfigure. The
+            # bump happens at most once per latch episode — the quorum it
+            # triggers reconfigures the comm, which clears the latch (and
+            # if THAT configure fails, the fresh latch bumps again).
+            self._comm_epoch += 1
+            self._logger.warn(
+                f"transport latched ({self._comm.errored()}); bumping "
+                f"comm_epoch to {self._comm_epoch} for coordinated "
+                "reconfigure"
+            )
+
         self._quorum_future = self._executor.submit(
             self._async_quorum,
             allow_heal=allow_heal,
@@ -427,6 +452,7 @@ class Manager:
             shrink_only=shrink_only,
             timeout=quorum_timeout,
             data_plane=self._data_plane,
+            comm_epoch=self._comm_epoch,
         )
 
     def _finish_quorum(self, quorum, allow_heal: bool) -> None:
@@ -628,6 +654,31 @@ class Manager:
         """Two-phase commit: drain pending collectives, apply a pending
         heal, then vote across the local ranks of this replica group
         (ref manager.py:545-598). True ⇒ the optimizer may be stepped."""
+        return self.should_commit_async(timeout=timeout).result()
+
+    def should_commit_async(
+        self, timeout: "float | timedelta | None" = None
+    ) -> Future:
+        """Overlappable two-phase commit.
+
+        The *prologue* runs synchronously on the caller's thread: drain
+        this step's pending collectives (transport errors latch here),
+        apply a pending heal, and cast the local vote. After it returns,
+        the step's inputs are FINAL — the decision can no longer depend on
+        anything the caller computes — so the caller may dispatch the
+        optimizer-update program concurrently with the barrier RPC, hiding
+        the round trip behind device time (the multi-peer analog of the
+        solo-wire fused path's tax removal; the reference has no
+        equivalent — its should_commit is a blocking seam between
+        allreduce and optimizer.step, ref manager.py:545-598).
+
+        Only the vote RPC rides the async executor. The returned Future
+        resolves to the global decision and applies the same counter
+        updates as :meth:`should_commit`; its ``local_should_commit``
+        attribute exposes this replica's ballot so a caller can skip the
+        optimistic dispatch when the outcome is already known to be False
+        (a False local vote makes the global AND False).
+        """
         for work in self._pending_work:
             if self.errored() is not None:
                 break
@@ -646,30 +697,40 @@ class Manager:
         local_should_commit = enough_replicas and self.errored() is None
         import time as _time
 
-        commit_start = _time.perf_counter()
-        should_commit = self._client.should_commit(
-            self._rank,
-            self._step,
-            local_should_commit,
-            timeout=_seconds(timeout) if timeout else self._timeout,
-        )
-        self.metrics.observe(
-            "commit_barrier", _time.perf_counter() - commit_start
-        )
-        self._logger.info(
-            f"should_commit={should_commit} enough_replicas={enough_replicas} "
-            f"errored={self.errored()}"
-        )
-        self.metrics.incr(
-            "steps_committed" if should_commit else "steps_discarded"
-        )
+        def _barrier() -> bool:
+            commit_start = _time.perf_counter()
+            should_commit = self._client.should_commit(
+                self._rank,
+                self._step,
+                local_should_commit,
+                timeout=_seconds(timeout) if timeout else self._timeout,
+            )
+            self.metrics.observe(
+                "commit_barrier", _time.perf_counter() - commit_start
+            )
+            self._logger.info(
+                f"should_commit={should_commit} "
+                f"enough_replicas={enough_replicas} "
+                f"errored={self.errored()}"
+            )
+            self.metrics.incr(
+                "steps_committed" if should_commit else "steps_discarded"
+            )
 
-        self._checkpoint_transport.disallow_checkpoint()
+            self._checkpoint_transport.disallow_checkpoint()
 
-        if should_commit:
-            self._step += 1
-            self._batches_committed += self.num_participants()
-        return should_commit
+            if should_commit:
+                self._step += 1
+                self._batches_committed += self.num_participants()
+            return should_commit
+
+        # The shared 1-thread executor serializes the barrier with any
+        # quorum work; no quorum is ever in flight here (the prologue's
+        # drain implies this step's wait_quorum already completed, and the
+        # next start_quorum follows the caller's step() return).
+        fut = self._executor.submit(_barrier)
+        fut.local_should_commit = local_should_commit  # type: ignore[attr-defined]
+        return fut
 
     # ----------------------------------------------------------------- state
 

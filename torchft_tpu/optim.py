@@ -39,7 +39,8 @@ class OptimizerWrapper:
     donor snapshot, ending bitwise-identical to the donor."""
 
     def __init__(self, manager, tx, state_fn=None,
-                 fence_depth: int = 1, fence_stride: int = 8) -> None:
+                 fence_depth: int = 1, fence_stride: int = 8,
+                 donate_update: bool = False) -> None:
         import jax
         import optax
 
@@ -94,6 +95,32 @@ class OptimizerWrapper:
 
         self._update = jax.jit(_update)
 
+        # Decide-then-apply variant for HBM-constrained multi-peer wires:
+        # donating (grads, opt_state, params) means the update program
+        # allocates NO second params+opt footprint — but a donated input
+        # cannot be rolled back, so the commit decision must precede the
+        # dispatch (the same soundness rule as fused_step), which exposes
+        # the barrier RPC on the critical path. The default overlapped
+        # path makes the opposite trade: transient 2x params+opt, RPC
+        # hidden behind device time. Pick per job via ``donate_update``.
+        #
+        # The extra ``probe`` output is the fence anchor: a COPIED scalar
+        # element of the new params. Fencing any leaf of new_params
+        # itself would crash one step later — the next committing step
+        # donates new_params back in, deleting the fenced buffer before
+        # its deferred device_get runs. The probe is a fresh 1-element
+        # buffer no later step ever consumes (the same role the loss aux
+        # plays for the fused path).
+        def _update_probed(grads, opt_state, params):
+            new_params, new_state = _update(grads, opt_state, params)
+            probe = jax.tree_util.tree_leaves(new_params)[0].ravel()[0]
+            return new_params, new_state, probe
+
+        self._donate_update = bool(donate_update)
+        self._update_donated = jax.jit(
+            _update_probed, donate_argnums=(0, 1, 2)
+        )
+
     def init(self, params) -> Any:
         return self.tx.init(params)
 
@@ -109,15 +136,47 @@ class OptimizerWrapper:
         self, params: Any, opt_state: Any, grads: Any
     ) -> Tuple[Any, Any, bool]:
         """Apply the update iff the replica group commits this step
-        (ref optim.py:53-55). Returns (params, opt_state, committed)."""
+        (ref optim.py:53-55). Returns (params, opt_state, committed).
+
+        Low-tax multi-peer design: the commit barrier's prologue
+        (``Manager.should_commit_async``) drains the transport futures,
+        applies any pending heal, and casts the local vote on this
+        thread; the barrier RPC then rides a background thread WHILE the
+        update program is dispatched — the decision never depends on the
+        update's output (it is a function of the allreduce outcome, which
+        is final before the dispatch), so the RPC round trip hides behind
+        device time instead of serializing ahead of it. On a
+        non-commit the freshly computed pair is simply dropped — the
+        inputs were NOT donated, so rollback is the no-op of returning
+        the caller's references (unit-tested in
+        tests/test_train_integration.py). A False local vote forces a
+        False global decision, so the dispatch is skipped entirely then.
+
+        With ``donate_update=True`` the order flips to decide-then-apply
+        with a fully donated update program (no transient second
+        params+opt footprint — the 1b multi-peer configuration), paying
+        the exposed barrier RPC instead; see __init__.
+        """
         self.classic_steps += 1
-        if self.manager.should_commit():
+        if self._donate_update:
+            return self._step_donated(params, opt_state, grads)
+        with self.metrics.timed("prologue"):
+            decision = self.manager.should_commit_async()
+        dispatched = False
+        if getattr(decision, "local_should_commit", True) is not False:
             if self.manager.did_heal() and self._state_fn is not None:
-                # should_commit just loaded the donor snapshot into the
+                # the prologue just loaded the donor snapshot into the
                 # user's holder; the caller's args predate it. Re-read so
                 # the (received-average) update lands on healed state.
                 params, opt_state = self._state_fn()
-            params, opt_state = self._update(grads, opt_state, params)
+            with self.metrics.timed("dispatch"):
+                new_params, new_opt = self._update(grads, opt_state, params)
+            dispatched = True
+        # Exposed barrier time only: whatever the RPC costs BEYOND the
+        # dispatch it overlapped — the honest per-step FT tax.
+        with self.metrics.timed("barrier"):
+            committed = bool(decision.result())
+        if committed and dispatched:
             # block_until_ready, deliberately NOT a device_get readback:
             # a 1-element D2H fence was measured to cost a full tunnel
             # round trip per step (125m bench: vs_baseline 0.89 -> 0.50).
@@ -126,8 +185,9 @@ class OptimizerWrapper:
             # updates are not donated, and its backpressure here is
             # validated by matched window/committed-step accounting on the
             # real chip (docs/evidence/bench_tpu_r3.json).
-            self._push_fence("block", params)
-            return params, opt_state, True
+            with self.metrics.timed("fence"):
+                self._push_fence("block", new_params)
+            return new_params, new_opt, True
         # Non-committing step (error latched, insufficient quorum, heal
         # retry): drain the fence by WAITING, not dropping — dropping
         # would let the first commit after a non-commit stretch dispatch
@@ -135,6 +195,44 @@ class OptimizerWrapper:
         # outstanding, exactly what the fence exists to prevent), and a
         # discarded step has no latency to protect anyway. Waiting also
         # releases the references, bounding stale HBM retention.
+        self._drain_fence()
+        if dispatched:
+            # The optimistically dispatched program was not adopted, but
+            # it is still queued on the device: block on it here, or a
+            # run of global-False decisions (a flapping peer) would
+            # enqueue one unawaited params+opt program per step — the
+            # host outrunning the device without bound, precisely what
+            # the fence exists to prevent. A discarded step has no
+            # latency to protect, so the wait costs nothing real.
+            self._wait_batch([("block", new_params)])
+        return params, opt_state, False
+
+    def _step_donated(
+        self, params: Any, opt_state: Any, grads: Any
+    ) -> Tuple[Any, Any, bool]:
+        """Decide-then-apply with full buffer donation (donate_update=True):
+        barrier first — a discarded step dispatches nothing, so donation
+        never needs rollback — then ONE donated update program whose peak
+        HBM adds no second params+opt footprint. The caller's (params,
+        opt_state, grads) references are CONSUMED on a committing step."""
+        with self.metrics.timed("barrier"):
+            committed = self.manager.should_commit()
+        if committed:
+            if self.manager.did_heal() and self._state_fn is not None:
+                params, opt_state = self._state_fn()
+            with self.metrics.timed("dispatch"):
+                new_params, new_opt, probe = self._update_donated(
+                    grads, opt_state, params
+                )
+            with self.metrics.timed("fence"):
+                # Donated chain: block_until_ready can return early on
+                # the tunnel (bench.py _sync rationale), so fence via a
+                # readback of the probe scalar — completion of any
+                # output of an XLA execution implies the whole execution
+                # (the donated update included) ran. See __init__ for
+                # why the probe, not a leaf of new_params.
+                self._push_fence("readback", probe)
+            return new_params, new_opt, True
         self._drain_fence()
         return params, opt_state, False
 
