@@ -163,6 +163,44 @@ def test_manager_local_fanin_two_ranks(lighthouse) -> None:
         mgr.shutdown()
 
 
+def test_manager_fanin_takes_max_comm_epoch(lighthouse) -> None:
+    """Any local rank's latched transport must force the group-wide
+    coordinated reconfigure: the group's lighthouse Member carries the
+    MAX comm_epoch across ranks (native/manager.cc fan-in), and a later
+    quorum with the bumped epoch mints a fresh quorum_id even though
+    membership did not change (native/quorum.cc quorum_changed)."""
+    mgr = _make_manager(lighthouse, "rep_0", world_size=2)
+    try:
+        c0 = ManagerClient(mgr.address())
+        c1 = ManagerClient(mgr.address())
+
+        def q(client, rank, step, epoch):
+            return client.quorum(
+                rank, step, f"meta{rank}", False, 10.0, comm_epoch=epoch
+            )
+
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            f0 = pool.submit(q, c0, 0, 1, 0)
+            f1 = pool.submit(q, c1, 1, 1, 0)
+            base = f0.result(timeout=15).quorum_id
+            assert f1.result(timeout=15).quorum_id == base
+
+            # only rank 1's transport latched -> its epoch bump must
+            # still bump the quorum id for the whole group
+            f0 = pool.submit(q, c0, 0, 2, 0)
+            f1 = pool.submit(q, c1, 1, 2, 1)
+            r0, r1 = f0.result(timeout=15), f1.result(timeout=15)
+            assert r0.quorum_id == r1.quorum_id == base + 1
+
+            # stable epochs again -> no further bump
+            f0 = pool.submit(q, c0, 0, 3, 0)
+            f1 = pool.submit(q, c1, 1, 3, 1)
+            assert f0.result(timeout=15).quorum_id == base + 1
+            assert f1.result(timeout=15).quorum_id == base + 1
+    finally:
+        mgr.shutdown()
+
+
 def test_should_commit_unanimous_and_veto(lighthouse) -> None:
     # Two-phase commit barrier over 2 local ranks (ref manager.rs:504-549).
     mgr = _make_manager(lighthouse, "rep_0", world_size=2)

@@ -687,3 +687,31 @@ def test_overlapped_discard_awaits_dispatched_program() -> None:
     # every discarded step waited on exactly its own dispatched tree
     blocks = [v for k, v in waited if k == "block"]
     assert len(blocks) == 3, f"{len(blocks)} waits for 3 discarded steps"
+
+
+def test_overlapped_step_awaits_dispatch_when_barrier_raises() -> None:
+    """A barrier-RPC failure (wedged manager, timeout) after the
+    optimistic dispatch must await the queued program before re-raising,
+    or every retried step leaks one unawaited params+opt execution
+    (code-review r5 finding)."""
+    from torchft_tpu.futures import failed_future
+
+    manager = mock_manager()
+
+    def _commit_async(**kw):
+        fut = failed_future(TimeoutError("barrier timed out"))
+        fut.local_should_commit = True
+        return fut
+
+    manager.should_commit_async.side_effect = _commit_async
+    opt = OptimizerWrapper(manager, optax.sgd(0.1))
+    waited = []
+    orig_wait = opt._wait_batch
+    opt._wait_batch = lambda entries: (
+        waited.extend(entries), orig_wait(entries)
+    )
+    params = {"w": jnp.ones(8)}
+    state = opt.init(params)
+    with pytest.raises(TimeoutError):
+        opt.step(params, state, {"w": jnp.full(8, 2.0)})
+    assert [k for k, _ in waited] == ["block"], waited

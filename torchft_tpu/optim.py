@@ -174,8 +174,19 @@ class OptimizerWrapper:
             dispatched = True
         # Exposed barrier time only: whatever the RPC costs BEYOND the
         # dispatch it overlapped — the honest per-step FT tax.
-        with self.metrics.timed("barrier"):
-            committed = bool(decision.result())
+        try:
+            with self.metrics.timed("barrier"):
+                committed = bool(decision.result())
+        except BaseException:
+            # Barrier RPC failed (manager wedged, timeout): the caller's
+            # retry loop treats the step as discarded, but the optimistic
+            # dispatch is already queued on the device — await it (and
+            # the fence) before re-raising, or every failed step would
+            # leak one unawaited params+opt program.
+            if dispatched:
+                self._wait_batch([("block", new_params)])
+            self._drain_fence()
+            raise
         if committed and dispatched:
             # block_until_ready, deliberately NOT a device_get readback:
             # a 1-element D2H fence was measured to cost a full tunnel
