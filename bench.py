@@ -422,31 +422,49 @@ def _start_watchdog() -> None:
     import threading
 
     limit = float(os.environ.get("BENCH_WATCHDOG_S", "300"))
-    if limit <= 0:
+    # Total-runtime bound, complementing the stall detector above: a
+    # DEGRADED tunnel can keep landing a _touch every few minutes
+    # without ever finishing, which no stall limit catches. Exiting from
+    # inside the process is claim-safe (the hazard is an external
+    # SIGTERM mid-claim); the emitted line carries whatever phases
+    # already measured. 0 disables.
+    max_runtime = float(os.environ.get("BENCH_MAX_RUNTIME_S", "5400"))
+    if limit <= 0 and max_runtime <= 0:
         return
+    start = time.monotonic()
+
+    def _fire(reason: str) -> None:
+        payload = {
+            "metric": "bench_error",
+            "value": _PARTIAL.get("ft_tokens_per_sec", 0.0),
+            "unit": "error",
+            "vs_baseline": _PARTIAL.get("vs_baseline", 0.0),
+            "error": reason,
+            **_PARTIAL,
+        }
+        for cleanup in list(_CLEANUPS):
+            try:
+                cleanup()
+            except Exception:  # noqa: BLE001
+                pass
+        _emit(payload, code=2)
 
     def _watch() -> None:
         while True:
             time.sleep(5.0)
             stalled = time.monotonic() - _PROGRESS["t"]
-            if stalled > limit:
-                payload = {
-                    "metric": "bench_error",
-                    "value": _PARTIAL.get("ft_tokens_per_sec", 0.0),
-                    "unit": "error",
-                    "vs_baseline": _PARTIAL.get("vs_baseline", 0.0),
-                    "error": (
-                        f"watchdog: no progress for {stalled:.0f}s "
-                        f"(last phase: {_PROGRESS['label']})"
-                    ),
-                    **_PARTIAL,
-                }
-                for cleanup in list(_CLEANUPS):
-                    try:
-                        cleanup()
-                    except Exception:  # noqa: BLE001
-                        pass
-                _emit(payload, code=2)
+            if limit > 0 and stalled > limit:
+                _fire(
+                    f"watchdog: no progress for {stalled:.0f}s "
+                    f"(last phase: {_PROGRESS['label']})"
+                )
+            elapsed = time.monotonic() - start
+            if max_runtime > 0 and elapsed > max_runtime:
+                _fire(
+                    f"watchdog: total runtime {elapsed:.0f}s exceeded "
+                    f"BENCH_MAX_RUNTIME_S={max_runtime:.0f} "
+                    f"(last phase: {_PROGRESS['label']})"
+                )
 
     threading.Thread(target=_watch, name="bench_watchdog",
                      daemon=True).start()

@@ -40,8 +40,10 @@ if 'cpu' not in str(jax.devices()[0].device_kind).lower():
 run_one() {
   # No shell `timeout` here: SIGTERMing bench.py mid-TPU-claim is the
   # kill-mid-claim hazard probe() warns about, and a killed bench emits
-  # no JSON tail. The bench's internal watchdog emits a parseable
-  # bench_error line and exits on its own on overrun.
+  # no JSON tail. Overruns are bounded INSIDE the bench instead: the
+  # stall watchdog (BENCH_WATCHDOG_S) catches hangs, and
+  # BENCH_MAX_RUNTIME_S catches degraded-but-progressing runs — both
+  # emit a parseable bench_error line and self-exit (claim-safe).
   local name="$1"; shift
   echo "=== $name ($(date +%H:%M:%S)) env: $*" >&2
   # A stale .json from an earlier invocation must never be attributed to
@@ -66,17 +68,17 @@ if ! probe; then
 fi
 
 # 1. canonical 125m (defaults: 2 replicas, TPU parent -> observer child)
-run_one bench_tpu_r5 BENCH_NO_FALLBACK=1
+run_one bench_tpu_r5 BENCH_NO_FALLBACK=1 BENCH_MAX_RUNTIME_S=2700
 
 # 2. 1b fault-free + FT + chaos (adafactor fits opt state on one chip)
-run_one bench_tpu_r5_1b BENCH_NO_FALLBACK=1 BENCH_MODEL=1b \
+run_one bench_tpu_r5_1b BENCH_NO_FALLBACK=1 BENCH_MAX_RUNTIME_S=2700 BENCH_MODEL=1b \
   BENCH_OPT=adafactor BENCH_BATCH=4 BENCH_SEQ=2048
 
 # 3. real data-plane peer: a model the 1-core CPU child can sustain in
 # lockstep (tiny ~0.1s/step; 125m would be ~15s/step on one core — the
 # wire waits on the slowest member). The chaos kill then hits a REAL
 # wire member and the heal streams real state (VERDICT r3 item 3).
-run_one bench_tpu_r5_chaos_peer BENCH_NO_FALLBACK=1 BENCH_MODEL=tiny \
+run_one bench_tpu_r5_chaos_peer BENCH_NO_FALLBACK=1 BENCH_MAX_RUNTIME_S=2700 BENCH_MODEL=tiny \
   BENCH_CHILD_HEAL=1 BENCH_CHILD_SYNC=1
 
 echo "all artifacts under docs/evidence/ — inspect before claiming" >&2

@@ -226,3 +226,27 @@ def test_bench_localsgd_diloco_fields():
     dl = payload["diloco"]
     assert dl["consistent"] and dl["syncs_committed"] >= 2, dl
     assert dl["commit_rate"] == 1.0
+
+
+def test_bench_max_runtime_bound_emits_parseable_error():
+    """A degraded-but-progressing run (every phase still touching the
+    watchdog) must still be bounded: BENCH_MAX_RUNTIME_S fires from
+    INSIDE the process (claim-safe self-exit) with a parseable tail
+    carrying whatever was already measured."""
+    out = _run_bench(
+        {
+            "BENCH_MODEL": "125m",      # slow enough to outlive the bound
+            "BENCH_BATCH": "1",
+            "BENCH_SEQ": "64",
+            "BENCH_REPLICAS": "1",
+            "BENCH_CHAOS": "0",
+            "BENCH_SYNC": "0",
+            "BENCH_WATCHDOG_S": "0",    # isolate the total-runtime bound
+            "BENCH_MAX_RUNTIME_S": "5",
+        },
+        timeout=300,
+    )
+    payload = _last_line_json(out)
+    assert payload["metric"] == "bench_error"
+    assert "total runtime" in payload["error"]
+    assert out.returncode == 2

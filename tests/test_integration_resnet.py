@@ -1,0 +1,239 @@
+"""BASELINE config #2 end-to-end: ResNet-18 (CIFAR shapes) DDP across 2
+replica groups with a kill + heal (ref: the train_ddp.py example family,
+/root/reference/train_ddp.py:33-156 + manager_integ_test.py:379-429).
+
+Beyond the toy-model integration suites, this exercises the heal path on
+a REAL vision model with mutable BatchNorm state: the live checkpoint
+must carry {params, batch_stats, opt} together — a heal that restored
+params but not batch_stats would diverge on the first post-heal forward.
+"""
+
+import logging
+import threading
+import time
+from typing import Dict
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchft_tpu.comm.store import StoreServer
+from torchft_tpu.comm.transport import TcpCommContext
+from torchft_tpu.control import Lighthouse
+from torchft_tpu.manager import Manager
+
+logger = logging.getLogger(__name__)
+
+pytest.importorskip("flax")
+
+
+def test_resnet18_ddp_two_groups_kill_and_heal() -> None:
+    from torchft_tpu.models.resnet import create_resnet18
+
+    model, variables0 = create_resnet18(jax.random.key(0))
+    tx = optax.sgd(0.05, momentum=0.9)
+
+    # ONE shared jitted step (a per-thread jit would compile twice).
+    @jax.jit
+    def grad_step(params, batch_stats, images, labels):
+        def loss_fn(p):
+            logits, new_state = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                images, train=True, mutable=["batch_stats"],
+            )
+            onehot = jax.nn.one_hot(labels, 10)
+            loss = optax.softmax_cross_entropy(logits, onehot).mean()
+            return loss, new_state["batch_stats"]
+
+        (loss, new_bs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        return loss, grads, new_bs
+
+    @jax.jit
+    def apply_update(params, opt_state, grads):
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt
+
+    rng = np.random.default_rng(0)
+    # identical synthetic CIFAR batch on every group: healthy groups stay
+    # bitwise-close step over step, making divergence detectable
+    images = jnp.asarray(
+        rng.standard_normal((2, 32, 32, 3)), dtype=jnp.float32
+    )
+    labels = jnp.asarray(rng.integers(0, 10, (2,)), dtype=jnp.int32)
+    # warm the compile before any thread starts
+    jax.block_until_ready(
+        grad_step(variables0["params"], variables0["batch_stats"],
+                  images, labels)[0]
+    )
+
+    lighthouse = Lighthouse(
+        min_replicas=1, join_timeout_ms=300, heartbeat_timeout_ms=1000
+    )
+    stop = threading.Event()
+    lock = threading.Lock()
+    target_commits, kill_at = 5, 2
+    commits = {0: 0, 1: 0}
+    history: Dict[int, Dict[int, np.ndarray]] = {0: {}, 1: {}}
+    bs_history: Dict[int, Dict[int, np.ndarray]] = {0: {}, 1: {}}
+    heals = [0]
+    errors: list = []
+
+    class _Killed(Exception):
+        pass
+
+    def group_main(group: int, restarted: bool) -> None:
+        store = StoreServer()
+        if restarted:
+            # poisoned re-init: a fresh seed — the heal must overwrite
+            # params AND batch_stats AND optimizer state
+            _, variables = create_resnet18(jax.random.key(99))
+        else:
+            variables = variables0
+        holder = {
+            "params": variables["params"],
+            "batch_stats": variables["batch_stats"],
+            "opt": tx.init(variables["params"]),
+        }
+
+        manager = Manager(
+            comm=TcpCommContext(timeout=10.0),
+            load_state_dict=lambda sd: holder.update(sd),
+            state_dict=lambda: dict(holder),
+            min_replica_size=1,
+            use_async_quorum=True,
+            timeout=15.0, quorum_timeout=15.0, connect_timeout=10.0,
+            rank=0, world_size=1,
+            store_addr=store.addr,
+            lighthouse_addr=lighthouse.address(),
+            replica_id=f"resnet_{group}_",
+            heartbeat_interval=0.05,
+        )
+        try:
+            while not stop.is_set():
+                if (group == 1 and not restarted
+                        and manager.current_step() >= kill_at):
+                    raise _Killed()
+                try:
+                    manager.start_quorum()
+                    _, grads, new_bs = grad_step(
+                        holder["params"], holder["batch_stats"],
+                        images, labels,
+                    )
+                    avg = manager.allreduce_pytree(grads).result(timeout=30)
+                    committed = manager.should_commit()
+                except (TimeoutError, RuntimeError) as e:
+                    logger.info("resnet step retry g%d: %s", group, e)
+                    continue
+                if committed:
+                    if manager.did_heal():
+                        with lock:
+                            heals[0] += 1
+                        # The barrier loaded the donor snapshot into the
+                        # holder; apply the received cohort average ON
+                        # TOP of it (the healer contributed zeros — the
+                        # avg IS the donor's gradient). BatchNorm stats
+                        # need one more step: the staged checkpoint
+                        # carries the donor's PRE-step stats, while the
+                        # donor's own forward advanced them during this
+                        # step — so re-run the forward on the healed
+                        # snapshot (same data → the exact same statistics
+                        # the donor computed), ending the step fully
+                        # identical, buffers included (ref
+                        # manager.py:492-543 ordering; BN buffers ride
+                        # the state_dict there the same way).
+                        _, _, new_bs = grad_step(
+                            holder["params"], holder["batch_stats"],
+                            images, labels,
+                        )
+                    new_params, new_opt = apply_update(
+                        holder["params"], holder["opt"],
+                        jax.tree_util.tree_map(jnp.asarray, avg),
+                    )
+                    holder["params"] = new_params
+                    holder["opt"] = new_opt
+                    holder["batch_stats"] = new_bs
+                    step = manager.current_step()
+                    leaf = np.asarray(
+                        jax.device_get(
+                            holder["params"]["Dense_0"]["kernel"]
+                        )
+                    )
+                    bs_leaf = np.asarray(
+                        jax.device_get(
+                            jax.tree_util.tree_leaves(
+                                holder["batch_stats"]
+                            )[0]
+                        )
+                    )
+                    with lock:
+                        history[group][step] = leaf
+                        bs_history[group][step] = bs_leaf
+                        commits[group] += 1
+                        if all(
+                            commits[g] >= target_commits for g in (0, 1)
+                        ):
+                            stop.set()
+                else:
+                    time.sleep(0.01)
+        finally:
+            manager.shutdown(wait=False)
+            store.shutdown()
+
+    def run_group(group: int) -> None:
+        restarted = False
+        while not stop.is_set():
+            try:
+                group_main(group, restarted)
+                return
+            except _Killed:
+                restarted = True
+            except Exception:  # noqa: BLE001
+                import traceback
+
+                with lock:
+                    errors.append(
+                        f"group {group}:\n{traceback.format_exc()}"
+                    )
+                stop.set()
+                return
+
+    threads = [
+        threading.Thread(target=run_group, args=(g,), daemon=True)
+        for g in (0, 1)
+    ]
+    deadline = time.time() + 240
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(max(1.0, deadline - time.time()))
+    stop.set()
+    for t in threads:
+        t.join(15.0)
+    lighthouse.shutdown()
+
+    assert not errors, "\n".join(errors)
+    with lock:
+        commits_snap = dict(commits)
+        hist_snap = {g: dict(h) for g, h in history.items()}
+        bs_snap = {g: dict(h) for g, h in bs_history.items()}
+        heals_snap = list(heals)
+    assert commits_snap[0] >= target_commits, commits_snap
+    assert commits_snap[1] >= target_commits, commits_snap
+    assert heals_snap[0] >= 1, "the killed group never healed"
+    common = sorted(set(hist_snap[0]) & set(hist_snap[1]))
+    post_heal = [s for s in common if s > kill_at + 1]
+    assert post_heal, f"no common steps after the kill/heal: {common}"
+    for s in common:
+        np.testing.assert_allclose(
+            hist_snap[0][s], hist_snap[1][s], rtol=1e-5, atol=1e-6,
+            err_msg=f"params divergence at step {s}",
+        )
+        np.testing.assert_allclose(
+            bs_snap[0][s], bs_snap[1][s], rtol=1e-5, atol=1e-6,
+            err_msg=f"batch_stats divergence at step {s}",
+        )

@@ -117,8 +117,13 @@ class OptimizerWrapper:
             return new_params, new_state, probe
 
         self._donate_update = bool(donate_update)
+        # Donate (opt_state, params) only: per parameter leaf the outputs
+        # are one new-params + the new opt leaves, so donating grads TOO
+        # would leave one param-shaped donation unusable every step (XLA
+        # warns per dispatch, and the grads donation buys no HBM — the
+        # peak already excludes a second params+opt footprint).
         self._update_donated = jax.jit(
-            _update_probed, donate_argnums=(0, 1, 2)
+            _update_probed, donate_argnums=(1, 2)
         )
 
     def init(self, params) -> Any:
@@ -221,11 +226,12 @@ class OptimizerWrapper:
     def _step_donated(
         self, params: Any, opt_state: Any, grads: Any
     ) -> Tuple[Any, Any, bool]:
-        """Decide-then-apply with full buffer donation (donate_update=True):
+        """Decide-then-apply with buffer donation (donate_update=True):
         barrier first — a discarded step dispatches nothing, so donation
         never needs rollback — then ONE donated update program whose peak
         HBM adds no second params+opt footprint. The caller's (params,
-        opt_state, grads) references are CONSUMED on a committing step."""
+        opt_state) references are CONSUMED on a committing step (grads
+        stay valid; donating them buys nothing — see __init__)."""
         with self.metrics.timed("barrier"):
             committed = self.manager.should_commit()
         if committed:
