@@ -108,6 +108,24 @@ def _sync_algorithms_phase() -> dict:
                 raise RuntimeError("bench: injected allreduce fault")
             return super().allreduce(arrays, op)
 
+    # ONE shared jitted inner step, warmed before any thread starts:
+    # per-group jits would compile `groups` times concurrently — a
+    # compile storm that blows the first sync's quorum deadline on a
+    # contended host — and per-phase jits would make DiLoCo pay the
+    # whole compile a second time.
+    tx = optax.sgd(1e-2)
+    train_step = make_train_step(cfg, tx, donate=False)
+    rng = np.random.default_rng(1234)  # same data every group
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch, seq_len)),
+        dtype=jnp.int32,
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+    params0 = init_params(cfg, jax.random.key(7))  # identical init
+    jax.block_until_ready(
+        train_step(params0, tx.init(params0), tokens, targets)[2]
+    )
+
     def run_one(algorithm: str, groups: int, sync_every: int,
                 target_syncs: int, fault_at_sync=None,
                 deadline_s: float = 120.0) -> dict:
@@ -122,23 +140,6 @@ def _sync_algorithms_phase() -> dict:
         syncs_attempted = [0]
         syncs_committed = [0]
         errors: list = []
-
-        # ONE shared jitted inner step, warmed before any thread starts:
-        # per-group jits would compile `groups` times concurrently — a
-        # compile storm that blows the first sync's quorum deadline on a
-        # contended host.
-        tx = optax.sgd(1e-2)
-        train_step = make_train_step(cfg, tx, donate=False)
-        rng = np.random.default_rng(1234)  # same data every group
-        tokens = jnp.asarray(
-            rng.integers(0, cfg.vocab_size, (batch, seq_len)),
-            dtype=jnp.int32,
-        )
-        targets = jnp.roll(tokens, -1, axis=1)
-        params0 = init_params(cfg, jax.random.key(7))  # identical init
-        jax.block_until_ready(
-            train_step(params0, tx.init(params0), tokens, targets)[2]
-        )
 
         def replica(gid: int) -> None:
             store = StoreServer()

@@ -96,13 +96,13 @@ class OptimizerWrapper:
         self._update = jax.jit(_update)
 
         # Decide-then-apply variant for HBM-constrained multi-peer wires:
-        # donating (grads, opt_state, params) means the update program
-        # allocates NO second params+opt footprint — but a donated input
-        # cannot be rolled back, so the commit decision must precede the
-        # dispatch (the same soundness rule as fused_step), which exposes
-        # the barrier RPC on the critical path. The default overlapped
-        # path makes the opposite trade: transient 2x params+opt, RPC
-        # hidden behind device time. Pick per job via ``donate_update``.
+        # donating (opt_state, params) means the update program allocates
+        # NO second params+opt footprint — but a donated input cannot be
+        # rolled back, so the commit decision must precede the dispatch
+        # (the same soundness rule as fused_step), which exposes the
+        # barrier RPC on the critical path. The default overlapped path
+        # makes the opposite trade: transient 2x params+opt, RPC hidden
+        # behind device time. Pick per job via ``donate_update``.
         #
         # The extra ``probe`` output is the fence anchor: a COPIED scalar
         # element of the new params. Fencing any leaf of new_params
@@ -168,7 +168,7 @@ class OptimizerWrapper:
         with self.metrics.timed("prologue"):
             decision = self.manager.should_commit_async()
         dispatched = False
-        if getattr(decision, "local_should_commit", True) is not False:
+        if getattr(decision, "local_should_commit", True):
             if self.manager.did_heal() and self._state_fn is not None:
                 # the prologue just loaded the donor snapshot into the
                 # user's holder; the caller's args predate it. Re-read so
