@@ -1110,3 +1110,83 @@ def test_classic_ft_step_overhead_small_on_solo_cpu() -> None:
         manager.shutdown(wait=False)
         store.shutdown()
         lighthouse.shutdown()
+
+
+def test_donated_step_loop_with_real_manager() -> None:
+    """donate_update=True against the real control plane: committing
+    steps consume (params, opt_state) into ONE donated program each; a
+    latched-error discard dispatches nothing and returns the caller's
+    live references; the trajectory matches the overlapped default path
+    step for step."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from torchft_tpu.ddp import DistributedDataParallel
+    from torchft_tpu.optim import OptimizerWrapper
+
+    lighthouse = Lighthouse(
+        min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=2000
+    )
+    trajectories = {}
+    try:
+        for mode, donate in (("overlapped", False), ("donated", True)):
+            store = StoreServer()
+            holder = {}
+            manager = Manager(
+                comm=TcpCommContext(timeout=5.0),
+                load_state_dict=lambda sd: holder.update(sd),
+                state_dict=lambda: dict(holder),
+                min_replica_size=1,
+                rank=0, world_size=1,
+                store_addr=store.addr,
+                lighthouse_addr=lighthouse.address(),
+                replica_id=f"donate_{mode}_",
+                timeout=5.0, quorum_timeout=5.0, connect_timeout=5.0,
+                heartbeat_interval=0.05,
+            )
+            try:
+                params = {"w": jnp.ones(32)}
+                tx = optax.adam(0.1)
+                opt = OptimizerWrapper(manager, tx, donate_update=donate)
+                ddp = DistributedDataParallel(manager)
+                state = opt.init(params)
+
+                @jax.jit
+                def grad_fn(p):
+                    return jax.grad(
+                        lambda p: jnp.mean((p["w"] - 5.0) ** 2)
+                    )(p)
+
+                traj = []
+                committed_steps = 0
+                injected = False
+                while committed_steps < 4:
+                    opt.begin_step()
+                    g = ddp.average_gradients(grad_fn(params))
+                    if (committed_steps == 2 and mode == "donated"
+                            and not injected):
+                        injected = True
+                        # inject a discard mid-loop (once): the donated
+                        # path must not have consumed any caller buffer
+                        # on a non-commit
+                        manager.report_error(RuntimeError("injected"))
+                        p2, s2, ok = opt.step(params, state, g)
+                        assert not ok
+                        assert p2 is params and s2 is state
+                        # liveness probe: reading a donated/deleted
+                        # buffer would raise here
+                        assert np.isfinite(float(jnp.sum(params["w"])))
+                        continue
+                    params, state, ok = opt.step(params, state, g)
+                    assert ok
+                    committed_steps += 1
+                    traj.append(np.asarray(jax.device_get(params["w"])))
+                trajectories[mode] = traj
+            finally:
+                manager.shutdown(wait=False)
+                store.shutdown()
+    finally:
+        lighthouse.shutdown()
+    for a, b in zip(trajectories["overlapped"], trajectories["donated"]):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
