@@ -95,6 +95,11 @@ def main() -> None:
     opt = OptimizerWrapper(
         manager, tx,
         state_fn=lambda: (state["params"], state["opt"]),
+        # HSDP is the HBM-bound shape: TORCHFT_TPU_DONATE_UPDATE=1 trades
+        # the overlapped commit barrier for a fully donated update program
+        # (no transient second params+opt footprint) when the model barely
+        # fits — see docs/operations.md §6.
+        donate_update=os.environ.get("TORCHFT_TPU_DONATE_UPDATE") == "1",
     )
     grad_step = make_grad_step(cfg)
 

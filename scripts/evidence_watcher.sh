@@ -1,0 +1,28 @@
+#!/bin/bash
+# Fires the r5 on-chip evidence sequence as soon as the tunnel probe
+# loop reports healthy (/tmp/tpu_status, written by the probe loop only
+# on a successful claim+matmul). Waits for host load to settle first so
+# CPU test noise doesn't starve the TPU run's host-side dispatch.
+#
+# Start this BEFORE the probe loop succeeds: a stale status file from an
+# earlier session would otherwise fire the sequence against a wedged
+# tunnel, stacking a hung claimant — so any pre-existing marker is
+# cleared at startup (the probe loop re-writes it on its next success).
+LOG=/root/repo/docs/evidence/watcher_r5.log
+rm -f /tmp/tpu_status
+echo "$(date +%H:%M:%S) watcher started (cleared any stale status)" >> "$LOG"
+while [ ! -f /tmp/tpu_status ]; do
+  sleep 60
+done
+echo "$(date +%H:%M:%S) tunnel healthy: $(cat /tmp/tpu_status)" >> "$LOG"
+for i in $(seq 1 60); do
+  load=$(awk '{print $1}' /proc/loadavg)
+  if awk -v l="$load" 'BEGIN{exit !(l < 1.0)}'; then break; fi
+  echo "$(date +%H:%M:%S) waiting for load to settle ($load)" >> "$LOG"
+  sleep 30
+done
+echo "$(date +%H:%M:%S) starting run_tpu_evidence.sh" >> "$LOG"
+bash /root/repo/scripts/run_tpu_evidence.sh >> "$LOG" 2>&1
+rc=$?
+echo "$(date +%H:%M:%S) evidence sequence finished rc=$rc" >> "$LOG"
+touch /tmp/evidence_done

@@ -149,6 +149,20 @@ def test_train_hsdp_example_runs() -> None:
     assert "step 3" in proc.stdout, proc.stdout
 
 
+def test_train_hsdp_example_donated_update() -> None:
+    # The HBM-bound variant: the same example with the donated
+    # decide-then-apply commit path (no transient 2x params+opt) must
+    # train identically — the apps-level seal on donate_update composing
+    # with sharded state.
+    proc = _run_example(
+        "examples/train_hsdp.py",
+        {"TOTAL_STEPS": "3", "TORCHFT_TPU_DONATE_UPDATE": "1"},
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "step 3" in proc.stdout, proc.stdout
+
+
 def test_train_ddp_example_durable_resume(tmp_path) -> None:
     # The DDP example's durable checkpoints are written by the async
     # writer; a second run with the same CKPT_PATH must resume from the
