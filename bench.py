@@ -259,10 +259,13 @@ def _sync_algorithms_phase() -> dict:
         deadline = t_start + deadline_s
         for t in threads:
             t.join(max(1.0, deadline - time.perf_counter()))
+        # the measured window ends HERE — the post-stop drain joins below
+        # are teardown (a straggler blocked in a transport timeout could
+        # add up to 15s/thread, which must not deflate inner_steps_per_sec)
+        elapsed = time.perf_counter() - t_start
         stop.set()
         for t in threads:
             t.join(15.0)
-        elapsed = time.perf_counter() - t_start
         lighthouse.shutdown()
         if errors:
             raise RuntimeError(f"{algorithm} phase failed:\n" + "\n".join(errors))
