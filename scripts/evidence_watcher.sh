@@ -9,12 +9,31 @@
 # tunnel, stacking a hung claimant — so any pre-existing marker is
 # cleared at startup (the probe loop re-writes it on its next success).
 LOG=/root/repo/docs/evidence/watcher_r5.log
+# Self-expiry (seconds; default 2h): the watcher outlives the builder
+# session, and the round-end DRIVER bench needs an uncontended claim on
+# the single-tenant tunnel — a watcher firing then would steal it and
+# force the GRADED artifact onto the CPU fallback. Expire well before.
+EXPIRY_S="${WATCHER_EXPIRY_S:-7200}"
+deadline=$(( $(date +%s) + EXPIRY_S ))
 rm -f /tmp/tpu_status
-echo "$(date +%H:%M:%S) watcher started (cleared any stale status)" >> "$LOG"
+echo "$(date +%H:%M:%S) watcher started (cleared any stale status; expires in ${EXPIRY_S}s)" >> "$LOG"
 while [ ! -f /tmp/tpu_status ]; do
+  if [ "$(date +%s)" -ge "$deadline" ]; then
+    echo "$(date +%H:%M:%S) watcher expired without a healthy probe; exiting so the round-end driver bench gets an uncontended claim" >> "$LOG"
+    exit 0
+  fi
   sleep 60
 done
 echo "$(date +%H:%M:%S) tunnel healthy: $(cat /tmp/tpu_status)" >> "$LOG"
+# The deadline is the last allowed START, not just a wait-loop bound: a
+# probe success at deadline-epsilon must not launch the (internally
+# bounded, up to ~2.25h) sequence — each run is capped at 45min by
+# BENCH_MAX_RUNTIME_S, so a pre-deadline start still finishes with
+# hours of margin before the round-end driver bench needs the claim.
+if [ "$(date +%s)" -ge "$deadline" ]; then
+  echo "$(date +%H:%M:%S) healthy but past expiry; NOT starting (driver bench owns the claim from here)" >> "$LOG"
+  exit 0
+fi
 for i in $(seq 1 60); do
   load=$(awk '{print $1}' /proc/loadavg)
   if awk -v l="$load" 'BEGIN{exit !(l < 1.0)}'; then break; fi
