@@ -1558,17 +1558,30 @@ def _run() -> None:
     # p50/p95/max split: p50≈avg with a lone large max pins a tail on a
     # single stall (transport hiccup / scheduling spike); a raised p95
     # means the cost is steady-state (VERDICT r4 weak #6).
+    # comm_* phases split the allreduce number along the transport's own
+    # seams (submit→wire queue wait, wire+reduce, future delivery) so the
+    # next PR can see which phase moved; comm_l{i}_* pins a regression on
+    # a single lane (t1_lane_ms below).
     _m = manager.metrics.snapshot()
     t1_overhead = {
         k: round(_m[k], 2)
         for k in (
             f"{name}_{stat}_ms"
-            for name in ("quorum", "commit_barrier", "allreduce")
+            for name in (
+                "quorum", "commit_barrier", "allreduce",
+                "comm_submit_wire", "comm_wire_reduce", "comm_reduce_future",
+            )
             for stat in ("avg", "p50", "p95", "max")
         )
         if k in _m
     }
     _PARTIAL["t1_overhead_ms"] = t1_overhead
+    t1_lane_ms = {
+        k: round(v, 2)
+        for k, v in _m.items()
+        if k.startswith("comm_l") and k.endswith(("_avg_ms", "_p95_ms"))
+    }
+    _PARTIAL["t1_lane_ms"] = t1_lane_ms
     # A quorum that shrank mid-window means some steps rode the solo fast
     # path; report the dip so T1 can't silently overstate multi-replica
     # throughput. Participant counts show whether the peers actually
@@ -1746,6 +1759,7 @@ def _run() -> None:
             ),
             "commit_rate": t1_commit_rate,
             "t1_overhead_ms": t1_overhead,
+            "t1_lane_ms": t1_lane_ms,
             "t1_fused_steps": t1_fused,
             "t1_classic_steps": t1_classic,
             "t1_phase_ms": t1_phase_ms,

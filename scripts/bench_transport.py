@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Loopback transport microbenchmark: allreduce latency vs payload size.
+
+Gives the DCN allreduce a trajectory independent of the full bench.py run:
+threads in one process, a real StoreServer rendezvous, real TCP sockets
+over loopback — the same code path bench.py's t1_overhead_ms allreduce
+numbers come from, minus jax and the manager. Sweeps payload size ×
+{star, ring} × channels and prints ONE JSON line so CI can diff runs.
+
+    python scripts/bench_transport.py            # CI-sized (<60s)
+    python scripts/bench_transport.py --full     # adds 32MB payloads
+
+Latency is measured on rank 0 as submit→result of a single allreduce
+(all lanes idle, so channels only changes lane assignment, not overlap);
+`gbps` is the aggregate goodput 2*payload*(n-1)/n per link equivalent —
+comparable across runs on the same host, not an absolute wire number.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from torchft_tpu.comm import StoreServer, TcpCommContext  # noqa: E402
+
+
+def _percentiles(vals):
+    vals = sorted(vals)
+    n = len(vals)
+    return {
+        "avg_ms": sum(vals) / n * 1e3,
+        "p50_ms": vals[n // 2] * 1e3,
+        "p95_ms": vals[max(0, math.ceil(n * 0.95) - 1)] * 1e3,
+        "max_ms": vals[-1] * 1e3,
+    }
+
+
+def _bench_config(store, algorithm, world, channels, nbytes, iters, warmup):
+    """One (algorithm, world, channels, payload) cell; returns rank-0
+    latency percentiles."""
+    prefix = f"bt_{algorithm}_{world}_{channels}_{nbytes}"
+    ctxs = [
+        TcpCommContext(timeout=30.0, algorithm=algorithm, channels=channels)
+        for _ in range(world)
+    ]
+    n_elems = nbytes // 4
+    lat = []
+
+    def _worker(rank):
+        ctx = ctxs[rank]
+        ctx.configure(f"{store.addr}/{prefix}", rank, world)
+        # allreduce reduces IN PLACE (donation contract), so the staging
+        # buffer must be refilled each iteration — outside the timed
+        # region, mirroring the DDP arena's pack step.
+        data = np.empty(n_elems, dtype=np.float32)
+        fill = np.float32(rank + 1)
+        for i in range(warmup + iters):
+            data.fill(fill)
+            t0 = time.perf_counter()
+            ctx.allreduce([data]).future().result(timeout=30)
+            if rank == 0 and i >= warmup:
+                lat.append(time.perf_counter() - t0)
+
+    with ThreadPoolExecutor(max_workers=world) as pool:
+        for f in [pool.submit(_worker, r) for r in range(world)]:
+            f.result(timeout=120)
+    for ctx in ctxs:
+        ctx.shutdown()
+    return _percentiles(lat)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true", help="add 32MB payloads")
+    ap.add_argument("--iters", type=int, default=None)
+    args = ap.parse_args()
+
+    sizes = [64 << 10, 1 << 20, 8 << 20]
+    if args.full:
+        sizes.append(32 << 20)
+    cells = []
+    t_start = time.perf_counter()
+    store = StoreServer()
+    try:
+        for nbytes in sizes:
+            iters = args.iters or max(5, min(30, (8 << 20) // nbytes * 4))
+            for algorithm, world in (("star", 2), ("ring", 3)):
+                for channels in (1, 4):
+                    res = _bench_config(
+                        store, algorithm, world, channels, nbytes,
+                        iters=iters, warmup=3,
+                    )
+                    cell = {
+                        "algorithm": algorithm,
+                        "world": world,
+                        "channels": channels,
+                        "payload_bytes": nbytes,
+                        "iters": iters,
+                        **{k: round(v, 3) for k, v in res.items()},
+                    }
+                    # star moves B up + B down on the root link; ring moves
+                    # 2B(n-1)/n per link. Report payload/latency goodput.
+                    cell["gbps"] = round(
+                        2 * nbytes / (res["avg_ms"] / 1e3) / 1e9, 3
+                    )
+                    cells.append(cell)
+                    print(
+                        f"# {algorithm} w{world} c{channels} "
+                        f"{nbytes >> 10}KB: avg {cell['avg_ms']}ms "
+                        f"p95 {cell['p95_ms']}ms",
+                        file=sys.stderr,
+                    )
+    finally:
+        store.shutdown()
+
+    print(json.dumps({
+        "bench": "transport_loopback_allreduce",
+        "wall_s": round(time.perf_counter() - t_start, 1),
+        "cells": cells,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,7 +1,29 @@
 #!/bin/bash
-# Full test pass: native build + pytest (parity with ref scripts/test.sh).
-set -ex
+# Single test entry point. Default: THE tier-1 gate from ROADMAP.md —
+# the exact command the reviewer runs, so builder and reviewer can never
+# drift (pipefail + DOTS_PASSED echo included).
+#
+#   scripts/test.sh          # tier-1 gate (non-slow tests, CPU devices)
+#   FULL=1 scripts/test.sh   # native build + entire suite (slow included)
 
+set -u
 cd "$(dirname "$0")/.."
-make -j -C native
-python -m pytest tests/ -q
+
+if [ "${FULL:-0}" = "1" ]; then
+    set -ex
+    make -j -C native
+    exec python -m pytest tests/ -q
+fi
+
+# T1_TIMEOUT: ROADMAP's 870s by default; slow sandboxes (this 2-core box
+# needs ~19 min for the full non-slow suite) can extend it without
+# changing what the gate runs.
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 "${T1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

@@ -106,7 +106,13 @@ class CommContext(ABC):
         self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
     ) -> Work:
         """Reduce arrays across ranks. The returned work's future resolves
-        to the reduced arrays (same shapes/dtypes, index-aligned)."""
+        to the reduced arrays (same shapes/dtypes, index-aligned).
+
+        Ownership: the caller donates ``arrays`` — implementations may
+        reduce in place and resolve the future to the submitted arrays
+        themselves (TcpCommContext does exactly that for contiguous,
+        writable inputs). Don't read a donated array until the future
+        resolves; on error its contents are unspecified."""
 
     @abstractmethod
     def allgather(self, arrays: Sequence[np.ndarray]) -> Work:

@@ -22,6 +22,18 @@ identical lanes on every rank and each lane's stream stays ordered.
 Reconfigure/shutdown closes sockets, which fails in-flight ops with
 ConnectionError — the abort analog for wedged transports (XLA collectives
 cannot be aborted; host sockets can, SURVEY.md §7 hard-part #2).
+
+Zero-copy data path: sends are scatter-gather (``sendmsg`` iovecs: one
+small metadata buffer plus the array bodies themselves — the full payload
+is never materialized into a fresh bytes object), receives land in
+step-persistent per-lane buffer pools via ``recv_into`` (two rotating
+payload slots, so a ring hop can forward the previous frame while the
+next one streams in), and ALLREDUCE payloads are decoded straight into
+the caller's arrays through the codec ``decode_into`` interface — the
+reduction is in place. The caller DONATES the arrays it submits: the
+returned future resolves to arrays that may alias the inputs (reduced in
+place); after a transport error their contents are unspecified, which is
+fine because an errored step never commits (manager error latching).
 """
 
 from __future__ import annotations
@@ -31,6 +43,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 from concurrent.futures import Future
 from datetime import timedelta
 from typing import Dict, List, Optional, Sequence
@@ -39,6 +52,7 @@ import numpy as np
 
 from torchft_tpu.comm.context import CommContext, ReduceOp, Work
 from torchft_tpu.comm.store import create_store_client
+from torchft_tpu.utils.metrics import Metrics
 
 logger = logging.getLogger(__name__)
 
@@ -54,20 +68,137 @@ _REDUCE_FNS = {
     ReduceOp.MIN: lambda a, b: np.minimum(a, b, out=a),
 }
 
+# Linux UIO_MAXIOV is 1024; stay under it per sendmsg call.
+_IOV_MAX = 512
+_HAS_SENDMSG = hasattr(socket.socket, "sendmsg")
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(n - len(buf), 1 << 20))
-        if not chunk:
+
+def _as_bytes_view(b) -> memoryview:
+    """Byte-typed memoryview of any buffer without copying. ndarrays go
+    through a uint8 reinterpret (extension dtypes like ml_dtypes bfloat16
+    reject the buffer protocol directly)."""
+    if isinstance(b, np.ndarray):
+        a = np.ascontiguousarray(b)
+        return memoryview(a.reshape(-1).view(np.uint8))
+    return memoryview(b).cast("B")
+
+
+def _iov_nbytes(bufs: Sequence) -> int:
+    return sum(
+        b.nbytes if isinstance(b, np.ndarray) else len(b) for b in bufs
+    )
+
+
+def _iov_join(bufs: Sequence) -> bytes:
+    """Materialize an iovec list (tests / lossy-codec self-decode only —
+    never on the send path)."""
+    return b"".join(bytes(_as_bytes_view(b)) for b in bufs)
+
+
+def _sendmsg_all(sock: socket.socket, bufs: Sequence) -> None:
+    """sendall semantics over an iovec list: every buffer hits the wire,
+    in order, with no concatenation into an intermediate payload."""
+    mvs = [mv for mv in (_as_bytes_view(b) for b in bufs) if len(mv)]
+    if not _HAS_SENDMSG:  # pragma: no cover — non-Linux fallback
+        sock.sendall(b"".join(mvs))
+        return
+    while mvs:
+        sent = sock.sendmsg(mvs[:_IOV_MAX])
+        if sent == 0:
             raise ConnectionError("comm transport connection closed")
-        buf.extend(chunk)
-    return bytes(buf)
+        while sent and mvs:
+            if sent >= len(mvs[0]):
+                sent -= len(mvs[0])
+                mvs.pop(0)
+            else:
+                mvs[0] = mvs[0][sent:]
+                sent = 0
+
+
+def _recv_into_exact(sock: socket.socket, mv: memoryview) -> None:
+    got, n = 0, len(mv)
+    while got < n:
+        r = sock.recv_into(mv[got:], min(n - got, 1 << 20))
+        if r == 0:
+            raise ConnectionError("comm transport connection closed")
+        got += r
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    """One-shot exact receive into a fresh right-sized buffer (rendezvous
+    handshakes); lanes use the pooled :class:`_RecvBufs` instead."""
+    buf = bytearray(n)
+    if n:
+        _recv_into_exact(sock, memoryview(buf))
+    return buf
+
+
+class _RecvBufs:
+    """Per-lane receive buffer pool, step-persistent and sized to the
+    largest seen frame. Headers land in a dedicated scratch; payloads
+    rotate across TWO slots so the full-duplex ring can forward the
+    previous frame (a view into slot A) while the next one is received
+    into slot B. Returned memoryviews are valid until the slot's next
+    reuse — consumers must decode/copy out before two more payload
+    receives."""
+
+    def __init__(self) -> None:
+        self._hdr = bytearray(4096)  # covers any metadata piece (dtype
+        # tags, <=255-dim shape vectors); payload bodies use the slots
+        self._slots = [bytearray(), bytearray()]
+        self._i = 0
+
+    def recv_header(self, sock: socket.socket, n: int) -> memoryview:
+        if n > len(self._hdr):
+            # n comes off the wire (dtype-tag/shape lengths): a corrupt
+            # or desynced frame must fail like every other framing error,
+            # not trip an assert (stripped under -O) and desync further.
+            raise ConnectionError(
+                f"oversized frame metadata ({n} bytes) — corrupt or "
+                "desynced stream"
+            )
+        mv = memoryview(self._hdr)[:n]
+        _recv_into_exact(sock, mv)
+        return mv
+
+    def recv_payload(self, sock: socket.socket, n: int) -> memoryview:
+        if n == 0:
+            return memoryview(b"")
+        self._i ^= 1
+        if len(self._slots[self._i]) < n:
+            self._slots[self._i] = bytearray(n)
+        mv = memoryview(self._slots[self._i])[:n]
+        _recv_into_exact(sock, mv)
+        return mv
+
+
+def _array_frame_iovecs(arrays: Sequence[np.ndarray]) -> List:
+    """Iovec list whose concatenation is byte-identical to
+    ``_pack_arrays(arrays)`` — metadata in small interleaved bytes
+    buffers, bodies as the arrays themselves (zero copy)."""
+    iov: List = []
+    meta = bytearray(struct.pack("<I", len(arrays)))
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        dt = _dtype_tag(a.dtype)
+        meta += struct.pack("<H", len(dt))
+        meta += dt
+        meta += struct.pack("<B", a.ndim)
+        if a.ndim:
+            meta += struct.pack(f"<{a.ndim}q", *a.shape)
+        meta += struct.pack("<Q", a.nbytes)
+        iov.append(bytes(meta))
+        meta = bytearray()
+        iov.append(a)
+    if meta:
+        iov.append(bytes(meta))
+    return iov
 
 
 def _send_arrays(sock: socket.socket, arrays: Sequence[np.ndarray]) -> None:
-    # Single framing definition: see _pack_arrays.
-    sock.sendall(_pack_arrays(arrays))
+    # Single framing definition: see _pack_arrays. Scatter-gather send —
+    # the payload is never materialized (was sock.sendall(_pack_arrays())).
+    _sendmsg_all(sock, _array_frame_iovecs(arrays))
 
 
 def _dtype_tag(d: np.dtype) -> bytes:
@@ -107,10 +238,13 @@ def _pack_arrays(arrays: Sequence[np.ndarray]) -> bytes:
     return b"".join(parts)
 
 
-def _unpack_arrays(data: bytes) -> List[np.ndarray]:
+def _unpack_arrays(data) -> List[np.ndarray]:
+    """Decode _pack_arrays' framing from any buffer (bytes or a reused
+    memoryview); the returned arrays own their memory."""
+    data = memoryview(data)
     offset = 0
 
-    def take(n: int) -> bytes:
+    def take(n: int) -> memoryview:
         nonlocal offset
         out = data[offset: offset + n]
         if len(out) != n:
@@ -122,7 +256,7 @@ def _unpack_arrays(data: bytes) -> List[np.ndarray]:
     out: List[np.ndarray] = []
     for _ in range(count):
         (dlen,) = struct.unpack("<H", take(2))
-        dtype = _dtype_from_tag(take(dlen).decode())
+        dtype = _dtype_from_tag(bytes(take(dlen)).decode())
         (ndim,) = struct.unpack("<B", take(1))
         shape = struct.unpack(f"<{ndim}q", take(8 * ndim)) if ndim else ()
         (nbytes,) = struct.unpack("<Q", take(8))
@@ -132,23 +266,33 @@ def _unpack_arrays(data: bytes) -> List[np.ndarray]:
     return out
 
 
-def _recv_arrays(sock: socket.socket) -> List[np.ndarray]:
-    # Streaming reader for _pack_arrays' framing (kept separate so huge
-    # payloads aren't double-buffered into one bytes object on receive).
-    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+def _recv_arrays(
+    sock: socket.socket, bufs: Optional[_RecvBufs] = None
+) -> List[np.ndarray]:
+    # Streaming reader for _pack_arrays' framing: each body lands in the
+    # lane's pooled buffer (no per-frame allocation) and is decoded ONCE
+    # into an owned output array — huge payloads are never double-buffered
+    # into a bytes object on receive.
+    bufs = bufs if bufs is not None else _RecvBufs()
+    (n,) = struct.unpack("<I", bufs.recv_header(sock, 4))
     out: List[np.ndarray] = []
     for _ in range(n):
-        (dlen,) = struct.unpack("<H", _recv_exact(sock, 2))
-        dtype = _dtype_from_tag(_recv_exact(sock, dlen).decode())
-        (ndim,) = struct.unpack("<B", _recv_exact(sock, 1))
-        shape = struct.unpack(f"<{ndim}q", _recv_exact(sock, 8 * ndim)) if ndim else ()
-        (nbytes,) = struct.unpack("<Q", _recv_exact(sock, 8))
-        data = _recv_exact(sock, nbytes)
-        out.append(np.frombuffer(data, dtype=dtype).reshape(shape).copy())
+        (dlen,) = struct.unpack("<H", bufs.recv_header(sock, 2))
+        dtype = _dtype_from_tag(bytes(bufs.recv_header(sock, dlen)).decode())
+        (ndim,) = struct.unpack("<B", bufs.recv_header(sock, 1))
+        shape = (
+            struct.unpack(f"<{ndim}q", bufs.recv_header(sock, 8 * ndim))
+            if ndim else ()
+        )
+        (nbytes,) = struct.unpack("<Q", bufs.recv_header(sock, 8))
+        body = bufs.recv_payload(sock, nbytes)
+        out.append(np.frombuffer(body, dtype=dtype).reshape(shape).copy())
     return out
 
 
 class _PendingOp:
+    __slots__ = ("opcode", "arrays", "op", "root", "fut", "t_submit")
+
     def __init__(self, opcode: int, arrays: List[np.ndarray], op: str,
                  root: int, fut: Future) -> None:
         self.opcode = opcode
@@ -156,6 +300,7 @@ class _PendingOp:
         self.op = op
         self.root = root
         self.fut = fut
+        self.t_submit = time.perf_counter()
 
 
 # --------------------------------------------------------------- compression
@@ -175,20 +320,20 @@ def _is_compressible(a: np.ndarray) -> bool:
 class _NoCodec:
     name = "none"
 
-    def encode_arrays(self, arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
-        return list(arrays)
-
-    def decode_arrays(
-        self, wire: List[np.ndarray], ref: Sequence[np.ndarray]
-    ) -> List[np.ndarray]:
-        return list(wire)
-
-    # chunk-view (ring) interface
+    # flat-view interface (star payload / ring chunk): encode_iovecs for
+    # the scatter-gather send side, decode_into for the in-place receive
+    # side, wire_nbytes for size validation.
     def wire_nbytes(self, v: np.ndarray) -> int:
         return v.nbytes
 
+    def encode_iovecs(self, views: Sequence[np.ndarray]) -> List:
+        """Encoded wire payload as an iovec list for scatter-gather send.
+        Concatenation is byte-identical to :meth:`encode_views`; the
+        identity codec returns the views themselves (zero copy)."""
+        return list(views)
+
     def encode_views(self, views: Sequence[np.ndarray]) -> bytes:
-        return b"".join(v.tobytes() for v in views)
+        return _iov_join(self.encode_iovecs(views))
 
     def decode_into(self, data: bytes, views: Sequence[np.ndarray],
                     combine) -> None:
@@ -208,27 +353,17 @@ class _AstypeCodec(_NoCodec):
         self.name = name
         self._wd = np.dtype(wire_dtype)
 
-    def encode_arrays(self, arrays):
-        return [
-            a.astype(self._wd) if _is_compressible(a) else a for a in arrays
-        ]
-
-    def decode_arrays(self, wire, ref):
-        return [
-            w.astype(r.dtype) if _is_compressible(r) else w
-            for w, r in zip(wire, ref)
-        ]
-
     def wire_nbytes(self, v: np.ndarray) -> int:
         if _is_compressible(v):
             return v.size * self._wd.itemsize
         return v.nbytes
 
-    def encode_views(self, views):
-        return b"".join(
-            (v.astype(self._wd) if _is_compressible(v) else v).tobytes()
-            for v in views
-        )
+    def encode_iovecs(self, views):
+        # The downcast inherently allocates; non-float views pass through
+        # uncopied.
+        return [
+            v.astype(self._wd) if _is_compressible(v) else v for v in views
+        ]
 
     def decode_into(self, data, views, combine):
         offset = 0
@@ -268,46 +403,21 @@ class _Int8Codec(_NoCodec):
         q = np.clip(np.rint(a / scale), -127, 127).astype(np.int8)
         return scale, q
 
-    def encode_arrays(self, arrays):
-        out: List[np.ndarray] = []
-        for a in arrays:
-            if _is_compressible(a):
-                scale, q = self._quantize(a)
-                out.append(np.asarray(scale))
-                out.append(q)
-            else:
-                out.append(a)
-        return out
-
-    def decode_arrays(self, wire, ref):
-        out: List[np.ndarray] = []
-        i = 0
-        for r in ref:
-            if _is_compressible(r):
-                scale = np.float32(wire[i])
-                q = wire[i + 1]
-                out.append((q.astype(r.dtype)) * r.dtype.type(scale))
-                i += 2
-            else:
-                out.append(wire[i])
-                i += 1
-        return out
-
     def wire_nbytes(self, v: np.ndarray) -> int:
         if _is_compressible(v):
             return 4 + v.size
         return v.nbytes
 
-    def encode_views(self, views):
-        parts = []
+    def encode_iovecs(self, views):
+        parts: List = []
         for v in views:
             if _is_compressible(v):
                 scale, q = self._quantize(v)
                 parts.append(np.float32(scale).tobytes())
-                parts.append(q.tobytes())
+                parts.append(q)
             else:
-                parts.append(v.tobytes())
-        return b"".join(parts)
+                parts.append(v)
+        return parts
 
     def decode_into(self, data, views, combine):
         offset = 0
@@ -336,6 +446,9 @@ _CODECS = {
     "int8": _Int8Codec,
 }
 
+# Stateless identity codec shared by every ring reduce-scatter phase.
+_NO_CODEC = _NoCodec()
+
 
 def _bf16_dtype():
     import ml_dtypes
@@ -355,6 +468,7 @@ class _Lane:
         self._queue: "queue.Queue[Optional[_PendingOp]]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._seq = 0
+        self._bufs = _RecvBufs()  # step-persistent rx pool, this lane only
         self._peer_socks: Dict[int, socket.socket] = {}   # star: root only
         self._root_sock: Optional[socket.socket] = None   # star: non-root
         self._next_sock: Optional[socket.socket] = None   # ring
@@ -409,13 +523,35 @@ class _Lane:
     # ------------------------------------------------------ transport thread
 
     def _run_loop(self) -> None:
+        # Phase split (per lane AND aggregate, see Metrics.snapshot):
+        #   submit_wire   — submission → lane dequeue (queue wait: how long
+        #                   the op sat behind earlier ops on this lane)
+        #   wire_reduce   — dequeue → wire exchange + reduction complete
+        #   reduce_future — result ready → future delivered (continuation
+        #                   chain: normalize/unpack callbacks)
+        metrics = self._ctx.metrics
+        tag = f"comm_l{self._lane_id}"
         while True:
             pending = self._queue.get()
             if pending is None:
                 return
+            t_deq = time.perf_counter()
             try:
                 result = self._execute(pending)
+                t_exec = time.perf_counter()
                 pending.fut.set_result(result)
+                t_done = time.perf_counter()
+                if pending.opcode == _OP_ALLREDUCE:
+                    # Allreduce only: these split bench's allreduce number
+                    # along the transport's seams — a heal broadcast or
+                    # allgather landing here would pin gradient-path
+                    # regressions on checkpoint traffic.
+                    metrics.observe(
+                        "comm_submit_wire", t_deq - pending.t_submit
+                    )
+                    metrics.observe("comm_wire_reduce", t_exec - t_deq)
+                    metrics.observe("comm_reduce_future", t_done - t_exec)
+                    metrics.observe(f"{tag}_wire_reduce", t_exec - t_deq)
             except Exception as e:  # noqa: BLE001 — latch every transport error
                 self._ctx._latch_error(e)
                 logger.warning(
@@ -447,44 +583,96 @@ class _Lane:
             return self._execute_root(p)
         return self._execute_peer(p)
 
-    def _execute_root(self, p: _PendingOp):
+    def _check_header(self, peer_rank: int, sock: socket.socket,
+                      opcode: int) -> None:
+        r_op, r_seq, _op = struct.unpack(
+            "<BQB", self._bufs.recv_header(sock, 10)
+        )
+        if r_op != opcode or r_seq != self._seq:
+            raise ConnectionError(
+                f"collective mismatch from rank {peer_rank}: "
+                f"got op={r_op} seq={r_seq}, expected op={opcode} "
+                f"seq={self._seq}"
+            )
+
+    # Star ALLREDUCE frame (both directions): [nbytes u64] + the codec's
+    # raw encoded stream over the FLAT views of the op's arrays — shapes
+    # are known on both sides (allreduce requires identical layouts), so
+    # the self-describing _pack_arrays framing is skipped and the payload
+    # decodes straight into the caller's arrays via codec.decode_into
+    # (the ring path's interface, now shared). Reduction is IN PLACE on
+    # the donated p.arrays; peers are drained in sorted rank order so the
+    # accumulation order — hence the float result — is bitwise identical
+    # to the sequential r=1..n-1 reduction.
+
+    def _star_allreduce_root(self, p: _PendingOp) -> List[np.ndarray]:
         codec = self._codec
+        reduce_fn = _REDUCE_FNS.get(
+            ReduceOp.SUM if p.op == ReduceOp.AVG else p.op
+        )
+        if reduce_fn is None:
+            raise ValueError(f"unsupported reduce op: {p.op}")
+        flats = [a.reshape(-1) for a in p.arrays]
+        expected = sum(codec.wire_nbytes(v) for v in flats)
+        for peer_rank, sock in sorted(self._peer_socks.items()):
+            self._check_header(peer_rank, sock, _OP_ALLREDUCE)
+            (nbytes,) = struct.unpack("<Q", self._bufs.recv_header(sock, 8))
+            if nbytes != expected:
+                raise ConnectionError(
+                    f"allreduce payload size mismatch from rank "
+                    f"{peer_rank}: {nbytes} != {expected} (divergent "
+                    "shapes?)"
+                )
+            payload = self._bufs.recv_payload(sock, nbytes)
+            # Streaming reduce: decoded straight into the accumulator,
+            # consumed before the next peer's receive reuses the slot.
+            codec.decode_into(payload, flats, reduce_fn)
+        if p.op == ReduceOp.AVG:
+            for f in flats:
+                np.divide(f, self._world_size, out=f)
+        # Fan out the ENCODED result; for a lossy codec the root then
+        # re-decodes its own encoded bytes so it sees values byte-identical
+        # to every peer (identity codec: the bytes ARE the accumulator's).
+        enc = codec.encode_iovecs(flats)
+        frame = [struct.pack("<Q", _iov_nbytes(enc)), *enc]
+        for _, sock in sorted(self._peer_socks.items()):
+            _sendmsg_all(sock, frame)
+        if type(codec) is not _NoCodec:
+            codec.decode_into(
+                _iov_join(enc), flats, lambda v, inc: np.copyto(v, inc)
+            )
+        return p.arrays
+
+    def _star_allreduce_peer(
+        self, p: _PendingOp, sock: socket.socket
+    ) -> List[np.ndarray]:
+        codec = self._codec
+        flats = [a.reshape(-1) for a in p.arrays]
+        enc = codec.encode_iovecs(flats)
+        expected = _iov_nbytes(enc)
+        _sendmsg_all(sock, [
+            struct.pack("<BQB", _OP_ALLREDUCE, self._seq, 0),
+            struct.pack("<Q", expected),
+            *enc,
+        ])
+        (nbytes,) = struct.unpack("<Q", self._bufs.recv_header(sock, 8))
+        if nbytes != expected:
+            raise ConnectionError(
+                f"allreduce reply size mismatch: {nbytes} != {expected} "
+                "(divergent shapes?)"
+            )
+        payload = self._bufs.recv_payload(sock, nbytes)
+        codec.decode_into(payload, flats, lambda v, inc: np.copyto(v, inc))
+        return p.arrays
+
+    def _execute_root(self, p: _PendingOp):
+        if p.opcode == _OP_ALLREDUCE:
+            return self._star_allreduce_root(p)
         contributions: Dict[int, List[np.ndarray]] = {0: p.arrays}
         for peer_rank, sock in sorted(self._peer_socks.items()):
-            opcode, seq, _op = struct.unpack("<BQB", _recv_exact(sock, 10))
-            if opcode != p.opcode or seq != self._seq:
-                raise ConnectionError(
-                    f"collective mismatch from rank {peer_rank}: "
-                    f"got op={opcode} seq={seq}, expected op={p.opcode} "
-                    f"seq={self._seq}"
-                )
-            wire = _recv_arrays(sock)
-            if p.opcode == _OP_ALLREDUCE:
-                wire = codec.decode_arrays(wire, p.arrays)
-            contributions[peer_rank] = wire
+            self._check_header(peer_rank, sock, p.opcode)
+            contributions[peer_rank] = _recv_arrays(sock, self._bufs)
 
-        if p.opcode == _OP_ALLREDUCE:
-            reduce_fn = _REDUCE_FNS.get(
-                ReduceOp.SUM if p.op == ReduceOp.AVG else p.op
-            )
-            if reduce_fn is None:
-                raise ValueError(f"unsupported reduce op: {p.op}")
-            acc = [
-                np.ascontiguousarray(a).astype(a.dtype, copy=True)
-                for a in p.arrays
-            ]
-            for r in range(1, self._world_size):
-                for i, a in enumerate(contributions[r]):
-                    reduce_fn(acc[i], a)
-            if p.op == ReduceOp.AVG:
-                for a in acc:
-                    np.divide(a, self._world_size, out=a)
-            # Fan out the ENCODED result and return its decoded form, so
-            # the root sees byte-identical values to every peer.
-            wire_out = codec.encode_arrays(acc)
-            for _, sock in sorted(self._peer_socks.items()):
-                _send_arrays(sock, wire_out)
-            return codec.decode_arrays(wire_out, p.arrays)
         if p.opcode == _OP_ALLGATHER:
             gathered = [contributions[r] for r in range(self._world_size)]
             flat: List[np.ndarray] = [
@@ -506,18 +694,21 @@ class _Lane:
     def _execute_peer(self, p: _PendingOp):
         sock = self._root_sock
         assert sock is not None
-        sock.sendall(struct.pack("<BQB", p.opcode, self._seq, 0))
+        if p.opcode == _OP_ALLREDUCE:
+            return self._star_allreduce_peer(p, sock)
         if p.opcode == _OP_BROADCAST and self._rank != p.root:
             # Root discards non-root contributions for broadcast; send an
             # empty frame instead of the full payload.
-            _send_arrays(sock, [])
-        elif p.opcode == _OP_ALLREDUCE:
-            _send_arrays(sock, self._codec.encode_arrays(p.arrays))
+            _sendmsg_all(sock, [
+                struct.pack("<BQB", p.opcode, self._seq, 0),
+                *_array_frame_iovecs([]),
+            ])
         else:
-            _send_arrays(sock, p.arrays)
-        result = _recv_arrays(sock)
-        if p.opcode == _OP_ALLREDUCE:
-            result = self._codec.decode_arrays(result, p.arrays)
+            _sendmsg_all(sock, [
+                struct.pack("<BQB", p.opcode, self._seq, 0),
+                *_array_frame_iovecs(p.arrays),
+            ])
+        result = _recv_arrays(sock, self._bufs)
         if p.opcode == _OP_ALLGATHER:
             # Decode the flattened [world, n_0, bufs_0..., n_1, ...] frame.
             idx = 0
@@ -536,21 +727,30 @@ class _Lane:
 
     _RING_HDR = struct.Struct("<BQHQ")  # opcode, seq, step, payload bytes
 
-    def _ring_sendrecv(self, opcode: int, step: int, payload: bytes) -> bytes:
+    def _ring_sendrecv(
+        self, opcode: int, step: int, bufs: Sequence, nbytes: int
+    ) -> memoryview:
         """Full-duplex one-step exchange: push to next while pulling from
         prev (a sender thread avoids deadlock once payloads exceed socket
         buffers). Every frame carries [opcode][seq][step][nbytes] and the
         receiver validates it — a desynced collective sequence fails fast
         instead of silently reducing misaligned bytes (parity with the
-        star path's mismatch check)."""
+        star path's mismatch check).
+
+        ``bufs`` is an iovec list (scatter-gather send, no payload
+        materialization). The received payload lands in this lane's rx
+        pool and is returned as a memoryview — the pool's 2-slot rotation
+        keeps it valid through exactly one more exchange, which is what
+        lets the all-gather phase forward it verbatim on the NEXT hop
+        while that hop's frame streams into the other slot."""
         next_sock, prev_sock = self._next_sock, self._prev_sock
         assert next_sock is not None and prev_sock is not None
         send_err: List[Optional[Exception]] = [None]
-        header = self._RING_HDR.pack(opcode, self._seq, step, len(payload))
+        header = self._RING_HDR.pack(opcode, self._seq, step, nbytes)
 
         def _send() -> None:
             try:
-                next_sock.sendall(header + payload)
+                _sendmsg_all(next_sock, [header, *bufs])
             except Exception as e:  # noqa: BLE001
                 send_err[0] = e
 
@@ -558,7 +758,7 @@ class _Lane:
         sender.start()
         try:
             r_op, r_seq, r_step, r_len = self._RING_HDR.unpack(
-                _recv_exact(prev_sock, self._RING_HDR.size)
+                self._bufs.recv_header(prev_sock, self._RING_HDR.size)
             )
             if (r_op, r_seq, r_step) != (opcode, self._seq, step):
                 raise ConnectionError(
@@ -566,7 +766,7 @@ class _Lane:
                     f"step={r_step}, expected op={opcode} seq={self._seq} "
                     f"step={step}"
                 )
-            data = _recv_exact(prev_sock, r_len)
+            data = self._bufs.recv_payload(prev_sock, r_len)
         finally:
             sender.join(timeout=self._timeout)
         if send_err[0] is not None:
@@ -592,38 +792,42 @@ class _Lane:
             # carry the seq header so desyncs fail fast
             hdr = self._RING_HDR
             if r == p.root:
-                payload = _pack_arrays(p.arrays)
-                self._next_sock.sendall(
-                    hdr.pack(_OP_BROADCAST, self._seq, 0, len(payload))
-                    + payload
-                )
+                iov = _array_frame_iovecs(p.arrays)
+                _sendmsg_all(self._next_sock, [
+                    hdr.pack(_OP_BROADCAST, self._seq, 0, _iov_nbytes(iov)),
+                    *iov,
+                ])
                 return [np.array(a, copy=True) for a in p.arrays]
             r_op, r_seq, _, r_len = hdr.unpack(
-                _recv_exact(self._prev_sock, hdr.size)
+                self._bufs.recv_header(self._prev_sock, hdr.size)
             )
             if (r_op, r_seq) != (_OP_BROADCAST, self._seq):
                 raise ConnectionError(
                     f"ring broadcast mismatch: got op={r_op} seq={r_seq}, "
                     f"expected op={_OP_BROADCAST} seq={self._seq}"
                 )
-            payload = _recv_exact(self._prev_sock, r_len)
+            payload = self._bufs.recv_payload(self._prev_sock, r_len)
             if (r + 1) % n != p.root:
-                self._next_sock.sendall(
-                    hdr.pack(_OP_BROADCAST, self._seq, 0, len(payload))
-                    + payload
-                )
+                # store-and-forward: the send completes before the pool
+                # slot can be reused, so the view is forwarded verbatim
+                _sendmsg_all(self._next_sock, [
+                    hdr.pack(_OP_BROADCAST, self._seq, 0, r_len),
+                    payload,
+                ])
             return _unpack_arrays(payload)
         if p.opcode == _OP_ALLGATHER:
             # rotate contributions n-1 times; slot by source rank
             gathered: List[Optional[List[np.ndarray]]] = [None] * n
             gathered[r] = [np.array(a, copy=True) for a in p.arrays]
-            current_bytes = _pack_arrays(gathered[r])
+            carry: List = _array_frame_iovecs(gathered[r])
+            carry_len = _iov_nbytes(carry)
             for step in range(n - 1):
                 src = (r - step - 1) % n
-                current_bytes = self._ring_sendrecv(
-                    _OP_ALLGATHER, step, current_bytes
+                data = self._ring_sendrecv(
+                    _OP_ALLGATHER, step, carry, carry_len
                 )
-                gathered[src] = _unpack_arrays(current_bytes)
+                gathered[src] = _unpack_arrays(data)
+                carry, carry_len = [data], len(data)
             return gathered
         raise ValueError(f"unknown opcode {p.opcode}")
 
@@ -646,8 +850,12 @@ class _Lane:
         # the star path (at the cost of compressing only half the wire
         # traffic).
         codec = self._codec
-        rs_codec = _NoCodec()
-        out = [np.array(np.ascontiguousarray(a), copy=True) for a in p.arrays]
+        rs_codec = _NO_CODEC
+        # In place on the donated arrays — no accumulator copy. Chunks
+        # are disjoint regions of `flats`, so the full-duplex send of
+        # chunk (r-s) never overlaps the concurrent receive+reduce of
+        # chunk (r-s-1).
+        out = p.arrays
         flats = [a.reshape(-1) for a in out]
 
         def chunk_views(c: int) -> List[np.ndarray]:
@@ -668,7 +876,9 @@ class _Lane:
             send_views = chunk_views(send_c)
             recv_views = chunk_views(recv_c)
             data = self._ring_sendrecv(
-                _OP_ALLREDUCE, step, rs_codec.encode_views(send_views)
+                _OP_ALLREDUCE, step,
+                rs_codec.encode_iovecs(send_views),
+                expect_len(rs_codec, send_views),
             )
             if len(data) != expect_len(rs_codec, recv_views):
                 raise ConnectionError(
@@ -680,16 +890,26 @@ class _Lane:
         # by its owner and the received bytes are forwarded VERBATIM, so
         # with a lossy codec every rank decodes identical bytes — replicas
         # stay bitwise consistent. The owner also re-decodes its own
-        # encoded chunk for the same reason.
+        # encoded chunk for the same reason (identity codec: the bytes
+        # ARE the chunk's, so both the materialize and the re-decode are
+        # skipped and the views travel as iovecs directly).
         own_c = (r + 1) % n
-        carry = codec.encode_views(chunk_views(own_c))
-        codec.decode_into(
-            carry, chunk_views(own_c), lambda v, inc: np.copyto(v, inc)
-        )
+        own_views = chunk_views(own_c)
+        if type(codec) is _NoCodec:
+            carry: List = codec.encode_iovecs(own_views)
+        else:
+            own_bytes = _iov_join(codec.encode_iovecs(own_views))
+            codec.decode_into(
+                own_bytes, own_views, lambda v, inc: np.copyto(v, inc)
+            )
+            carry = [own_bytes]
+        carry_len = expect_len(codec, own_views)
         for step in range(n - 1):
             recv_c = (r - step) % n
             recv_views = chunk_views(recv_c)
-            data = self._ring_sendrecv(_OP_ALLREDUCE, n - 1 + step, carry)
+            data = self._ring_sendrecv(
+                _OP_ALLREDUCE, n - 1 + step, carry, carry_len
+            )
             if len(data) != expect_len(codec, recv_views):
                 raise ConnectionError(
                     "ring allreduce chunk size mismatch (divergent shapes?)"
@@ -697,7 +917,7 @@ class _Lane:
             codec.decode_into(
                 data, recv_views, lambda v, inc: np.copyto(v, inc)
             )
-            carry = data
+            carry, carry_len = [data], len(data)
 
         if p.op == ReduceOp.AVG:
             for f in flats:
@@ -753,6 +973,15 @@ class TcpCommContext(CommContext):
         self._listener: Optional[socket.socket] = None
         self._error: Optional[Exception] = None
         self._op_delay = 0.0  # test hook: simulated per-op wire latency
+        # Per-lane phase timers (comm_submit_wire / comm_wire_reduce /
+        # comm_reduce_future + comm_l{i}_wire_reduce). The Manager shares
+        # its own Metrics in via set_metrics so bench surfaces both.
+        self.metrics = Metrics()
+
+    def set_metrics(self, metrics: Metrics) -> None:
+        """Record lane phase timings into ``metrics`` (call before
+        ``configure``; lanes bind it at thread start)."""
+        self.metrics = metrics
 
     # ------------------------------------------------------------ lifecycle
 
@@ -968,6 +1197,18 @@ class TcpCommContext(CommContext):
 
     # ----------------------------------------------------------- collectives
 
+    @staticmethod
+    def _prepare(a) -> np.ndarray:
+        """Donation contract: ALLREDUCE reduces in place, so the submitted
+        array must be contiguous and writable — anything else (e.g. the
+        read-only views jax.device_get can return) is copied once here;
+        caller-owned staging buffers pass through untouched and the future
+        resolves to those same arrays, reduced."""
+        a = np.asarray(a)
+        if not (a.flags["C_CONTIGUOUS"] and a.flags["WRITEABLE"]):
+            a = np.array(a)
+        return a
+
     def _submit(self, opcode: int, arrays: Sequence[np.ndarray], op: str,
                 root: int) -> Work:
         fut: Future = Future()
@@ -979,7 +1220,7 @@ class TcpCommContext(CommContext):
             )
             return Work(fut)
         pending = _PendingOp(
-            opcode, [np.asarray(a) for a in arrays], op, root, fut
+            opcode, [self._prepare(a) for a in arrays], op, root, fut
         )
         # Lock pairs with shutdown(): either we enqueue before the sentinel
         # (op will be drained) or we observe no lanes and fail fast.

@@ -13,6 +13,13 @@ ref ddp.py:55-61), reduces each bucket through the manager (error-latching),
 and returns the averaged pytree. Healing replicas contribute zeros and
 receive the average — which is exactly how they end a step bitwise-identical
 to their donor.
+
+Buckets live in a step-persistent staging arena (one flat host array per
+bucket): D2H copies land into it, the transport reads from it and reduces
+into it in place (the comm-layer donation contract), and the result
+leaves are views of it until the H2D copy — no per-step bucket-sized
+allocation, no transport-side payload copies (docs/architecture.md, "Wire
+format and the zero-copy hot path").
 """
 
 from __future__ import annotations
@@ -64,9 +71,44 @@ class _BucketPlan:
     def signature(self) -> Tuple:
         return tuple(zip(self.shapes, [d.str for d in self.dtypes]))
 
+    def alloc_staging(self) -> List[np.ndarray]:
+        """One flat host array per bucket — the step-persistent staging
+        arena. Reused every step: D2H copies land into it, the transport
+        reads from it AND reduces into it in place (the comm donation
+        contract), and the unpacked result leaves are views of it until
+        the H2D copy. No per-step bucket-sized allocation survives."""
+        return [
+            np.empty(
+                sum(self.sizes[i] for i in bucket),
+                dtype=self.dtypes[bucket[0]],
+            )
+            for bucket in self.buckets
+        ]
+
+    def pack_bucket_into(
+        self,
+        bucket: Sequence[int],
+        bucket_leaves: Sequence[np.ndarray],
+        out: np.ndarray,
+    ) -> np.ndarray:
+        """Land one bucket's (already-host) leaves into its staging slice,
+        in plan order — the reusable-arena replacement for the fresh
+        np.concatenate pack_bucket did every step."""
+        offset = 0
+        for i, leaf in zip(bucket, bucket_leaves):
+            n = self.sizes[i]
+            np.copyto(
+                out[offset: offset + n],
+                np.asarray(leaf).reshape(-1),
+                casting="no",
+            )
+            offset += n
+        return out
+
     @staticmethod
     def pack_bucket(bucket_leaves: Sequence[np.ndarray]) -> np.ndarray:
-        """Flatten one bucket's (already-host) leaves, in plan order."""
+        """Flatten one bucket's (already-host) leaves, in plan order
+        (allocating variant, kept for callers without an arena)."""
         if len(bucket_leaves) == 1:
             return np.ascontiguousarray(bucket_leaves[0]).ravel()
         return np.concatenate([l.ravel() for l in bucket_leaves])
@@ -89,6 +131,8 @@ class DistributedDataParallel:
         self._manager = manager
         self._bucket_bytes = bucket_bytes
         self._plan: "_BucketPlan | None" = None
+        self._staging: "List[np.ndarray] | None" = None
+        self._inflight: "Any | None" = None
         self._plan_lock = threading.Lock()
 
     def _get_plan(self, host_leaves: List[np.ndarray]) -> _BucketPlan:
@@ -113,8 +157,10 @@ class DistributedDataParallel:
     def average_gradients(self, grads: Any) -> Any:
         """Average a grad pytree across replica groups. Blocking; returns a
         pytree of jax arrays with the input structure. On transport error
-        the original grads come back and the error is latched — the commit
-        gate (OptimizerWrapper.step) will discard the step."""
+        the error is latched and the returned values are UNSPECIFIED (the
+        staging buffers may be partially reduced — donation contract);
+        that is safe because the commit gate (OptimizerWrapper.step)
+        discards the step, but don't log/inspect grads after an error."""
         return self.average_gradients_async(grads).result()
 
     def average_gradients_async(self, grads: Any):
@@ -151,31 +197,64 @@ class DistributedDataParallel:
         plan = self._get_plan(leaves)
 
         # Pipelined per-bucket issue (the mid-backward comm-hook analog,
-        # ref ddp.py:49-71): block only on bucket k's leaves, submit its
+        # ref ddp.py:49-71): block only on bucket k's leaves, land them in
+        # bucket k's slice of the persistent staging arena, submit its
         # transport op, then move to bucket k+1 — so bucket k rides the
         # wire (on its own transport lane) while later host copies land.
+        # The transport reduces IN PLACE into the staging buffer (comm
+        # donation contract) and unpack returns views of it, so the only
+        # copies per bucket are the D2H landing and the final H2D — the
+        # arena is safely reusable next step because jnp.array (an
+        # explicit copy) materializes the result before this future
+        # resolves.
+        from torchft_tpu.utils.profiling import host_span
+
+        # One outstanding average at a time: the staging arena is shared
+        # across calls, so packing a second step while the first is still
+        # on the wire would reduce corrupted buffers WITHOUT any error —
+        # both steps would commit wrong gradients. (Per-bucket pipelining
+        # within one call is unaffected; it uses disjoint bucket slices.)
+        if self._inflight is not None and not self._inflight.done():
+            raise RuntimeError(
+                "average_gradients_async called while the previous call's "
+                "future is unresolved; the staging arena supports one "
+                "outstanding average — await the prior result first"
+            )
+        if self._staging is None:
+            self._staging = plan.alloc_staging()
+        staging = self._staging
         works = []
-        for bucket in plan.buckets:
-            host_b = [np.asarray(jax.device_get(leaves[i])) for i in bucket]
-            packed = plan.pack_bucket(host_b)
+        for k, bucket in enumerate(plan.buckets):
+            with host_span(f"ddp_pack_bucket{k}"):
+                host_b = [
+                    np.asarray(jax.device_get(leaves[i])) for i in bucket
+                ]
+                packed = plan.pack_bucket_into(bucket, host_b, staging[k])
             works.append(self._manager.allreduce_arrays([packed]))
 
         def _finish(_f) -> Any:
             reduced = []
             for w in works:
                 reduced.append(w.future().result()[0])
-            out_leaves = plan.unpack(reduced)
-            device_leaves = [
-                jnp.asarray(a, dtype=l.dtype) if hasattr(l, "dtype") else a
-                for a, l in zip(out_leaves, leaves)
-            ]
+            with host_span("ddp_unpack"):
+                out_leaves = plan.unpack(reduced)
+                # jnp.array (copy=True), NOT jnp.asarray: on the CPU
+                # backend asarray aliases the numpy buffer — these leaves
+                # are views of the reusable arena, and an aliased result
+                # would be silently overwritten by the NEXT step's pack.
+                device_leaves = [
+                    jnp.array(a, dtype=l.dtype) if hasattr(l, "dtype") else a
+                    for a, l in zip(out_leaves, leaves)
+                ]
             return jax.tree_util.tree_unflatten(treedef, device_leaves)
 
         from torchft_tpu.futures import future_all
 
-        return future_chain(
+        fut = future_chain(
             future_all([w.future() for w in works]), _finish
         )
+        self._inflight = fut
+        return fut
 
 
 class PureDistributedDataParallel:

@@ -233,6 +233,12 @@ class Manager:
         self._replica_world_size: int = 0
         self._did_heal = False
         self.metrics = Metrics()
+        # Share our metrics sink with the transport so its per-lane phase
+        # timers (comm_submit_wire / comm_wire_reduce / comm_reduce_future)
+        # land next to quorum/commit_barrier/allreduce in one snapshot.
+        set_metrics = getattr(comm, "set_metrics", None)
+        if callable(set_metrics):
+            set_metrics(self.metrics)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -262,6 +268,14 @@ class Manager:
         * while healing / not participating, contributes zeros
         * transport errors are latched, never raised — the future always
           completes (with the corrupt-but-unused input as the default)
+
+        Buffer ownership: the caller DONATES ``arrays`` — the transport
+        reduces in place, so the future may resolve to the very arrays
+        submitted (contiguous + writable inputs, e.g. DDP's staging
+        arena, are never copied; read-only device_get views are copied
+        once at submit). Do not read a donated array until the future
+        resolves; after a latched error its contents are unspecified,
+        which is safe because the step never commits.
         """
         arrays = [np.asarray(a) for a in arrays]
         if op == ReduceOp.AVG and any(
@@ -309,12 +323,20 @@ class Manager:
                     # MAX/MIN must not be scaled at all.
                     return reduced
                 scale = 1.0 / max(1, self.num_participants())
-                return [
-                    (a * np.asarray(scale).astype(a.dtype))
-                    if _is_float_dtype(a.dtype)
-                    else a
-                    for a in reduced
-                ]
+                # In place: the reduced arrays are already donated to this
+                # op (they alias the caller's staging buffers), so scaling
+                # them in place keeps the zero-copy chain intact. Identity
+                # contexts (Dummy/solo) can hand back read-only views —
+                # those take the allocating path.
+                reduced = list(reduced)
+                for i, a in enumerate(reduced):
+                    if _is_float_dtype(a.dtype):
+                        s = np.asarray(scale).astype(a.dtype)
+                        if a.flags.writeable:
+                            np.multiply(a, s, out=a)
+                        else:
+                            reduced[i] = a * s
+                return reduced
 
             fut = future_chain(work.future(), _normalize)
             return Work(self.wrap_future(fut, list(arrays)))

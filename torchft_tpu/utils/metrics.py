@@ -54,6 +54,10 @@ class Metrics:
     def snapshot(self) -> Dict[str, float]:
         """Flat dict: counters as-is; timings as name_{avg,p50,p95,max}_ms.
 
+        High-cardinality producers (the transport's per-lane ``comm_l*``
+        timers) share this one sink; consumers filter the returned dict
+        by key prefix rather than paying a second locked sort pass.
+
         The percentile split exists to make tails attributable: an
         avg/max pair cannot distinguish one transport stall from steady
         scheduling jitter, while p50≈avg≪max pins the cost on a single

@@ -21,7 +21,7 @@ import os
 from contextlib import contextmanager
 from typing import Optional
 
-__all__ = ["StepProfiler", "trace"]
+__all__ = ["StepProfiler", "trace", "host_span"]
 
 
 @contextmanager
@@ -35,6 +35,25 @@ def trace(log_dir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+@contextmanager
+def host_span(name: str):
+    """Annotate a host-side region (gradient pack/unpack, transport
+    phases) so it shows up on the profiler timeline next to the XLA ops
+    it overlaps with. Near-zero cost when no trace is active (a
+    TraceAnnotation outside a trace window is a no-op); degrades to a
+    plain passthrough when jax is unavailable (numpy-only transport
+    tools)."""
+    try:
+        import jax
+
+        annotation = jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover — jax-less environment
+        yield
+        return
+    with annotation:
+        yield
 
 
 class StepProfiler:
