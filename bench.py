@@ -336,6 +336,16 @@ def _host_cores() -> int:
             else (os.cpu_count() or 1))
 
 
+def _bench_chunk_bytes() -> int:
+    """Transport stripe-chunk size for the bench's gradient wire.
+    BENCH_CHUNK_KB overrides the library default (1024) — the CPU-host
+    A/B runs the tiny model whose ~0.8MB bucket never splits at 1MB, so
+    a sub-MB setting is how the striped lane model is exercised (and the
+    lane-balance gauge made meaningful) at that scale. Must match
+    between parent and child replicas; the child reads the same env."""
+    return int(os.environ.get("BENCH_CHUNK_KB", "1024")) << 10
+
+
 def _chaos_ratios(t2, t1, t0, n_replicas, backend) -> dict:
     """Chaos efficiency fields with the contended-host qualification.
 
@@ -1107,7 +1117,7 @@ def _child_main() -> None:
     # cohort's transport never includes or waits on it.
     observer = not (allow_heal or sync_grads)
     manager = Manager(
-        comm=TcpCommContext(timeout=60.0),
+        comm=TcpCommContext(timeout=60.0, chunk_bytes=_bench_chunk_bytes()),
         load_state_dict=lambda sd: holder.update(sd),
         state_dict=lambda: dict(holder),
         min_replica_size=1,
@@ -1344,7 +1354,7 @@ def _run() -> None:
     opt_state_holder = {"params": params_ft, "opt": opt_init}
 
     manager = Manager(
-        comm=TcpCommContext(timeout=60.0),
+        comm=TcpCommContext(timeout=60.0, chunk_bytes=_bench_chunk_bytes()),
         load_state_dict=lambda sd: opt_state_holder.update(sd),
         state_dict=lambda: dict(opt_state_holder),
         min_replica_size=1,
@@ -1582,6 +1592,21 @@ def _run() -> None:
         if k.startswith("comm_l") and k.endswith(("_avg_ms", "_p95_ms"))
     }
     _PARTIAL["t1_lane_ms"] = t1_lane_ms
+    # Lane-balance gauge: max/mean of the per-lane wire_reduce averages.
+    # 1.0 = the striped scheduler is spreading bytes evenly; the PR 1
+    # one-op-one-lane model measured ~1.8 on r06 (comm_l0 18.9ms vs
+    # comm_l1 10.5ms) — a regression back above ~1.3 means striping
+    # stopped engaging (chunk grid collapsed to one chunk, or ops pinned
+    # to one lane).
+    _lane_avgs = [
+        v for k, v in _m.items()
+        if k.startswith("comm_l") and k.endswith("_wire_reduce_avg_ms")
+    ]
+    t1_lane_balance = (
+        round(max(_lane_avgs) / (sum(_lane_avgs) / len(_lane_avgs)), 3)
+        if len(_lane_avgs) >= 2 and any(_lane_avgs) else None
+    )
+    _PARTIAL["t1_lane_balance"] = t1_lane_balance
     # A quorum that shrank mid-window means some steps rode the solo fast
     # path; report the dip so T1 can't silently overstate multi-replica
     # throughput. Participant counts show whether the peers actually
@@ -1760,6 +1785,7 @@ def _run() -> None:
             "commit_rate": t1_commit_rate,
             "t1_overhead_ms": t1_overhead,
             "t1_lane_ms": t1_lane_ms,
+            "t1_lane_balance": t1_lane_balance,
             "t1_fused_steps": t1_fused,
             "t1_classic_steps": t1_classic,
             "t1_phase_ms": t1_phase_ms,

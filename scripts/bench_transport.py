@@ -2,18 +2,35 @@
 """Loopback transport microbenchmark: allreduce latency vs payload size.
 
 Gives the DCN allreduce a trajectory independent of the full bench.py run:
-threads in one process, a real StoreServer rendezvous, real TCP sockets
-over loopback — the same code path bench.py's t1_overhead_ms allreduce
-numbers come from, minus jax and the manager. Sweeps payload size ×
-{star, ring} × channels and prints ONE JSON line so CI can diff runs.
+one PROCESS per rank (like production — one trainer process per host), a
+real StoreServer rendezvous, real TCP sockets over loopback — the same
+code path bench.py's t1_overhead_ms allreduce numbers come from, minus
+jax and the manager. Sweeps payload size × {star, ring} × channels and
+prints ONE JSON line so CI can diff runs.
 
-    python scripts/bench_transport.py            # CI-sized (<60s)
+Ranks were threads in one process through r06; that shares a single GIL
+across every "rank", so the measurement was dominated by GIL handoffs
+between lane/rank threads (observed 3x swings) rather than transport
+behavior. Worker processes each carry their own interpreter, matching
+the deployment topology.
+
+    python scripts/bench_transport.py            # CI-sized
     python scripts/bench_transport.py --full     # adds 32MB payloads
+    python scripts/bench_transport.py --stripe-sweep   # chunk x lanes x codec
 
-Latency is measured on rank 0 as submit→result of a single allreduce
-(all lanes idle, so channels only changes lane assignment, not overlap);
-`gbps` is the aggregate goodput 2*payload*(n-1)/n per link equivalent —
-comparable across runs on the same host, not an absolute wire number.
+With chunk striping (PR 2) a single op rides ALL lanes, so channels>1
+changes single-op latency, not just multi-op overlap. `gbps` is the
+aggregate goodput 2*payload*(n-1)/n per link equivalent — comparable
+across runs on the same host, not an absolute wire number.
+
+--stripe-sweep grids chunk size x channels x codec at a fixed payload
+(default 8MB, --sweep-payload-mb to change) for star w2 and ring w3, and
+reports per-cell `lane_balance` (max/mean of the per-lane wire_reduce
+averages — 1.0 is perfectly balanced). Add --ab-baseline PATH (a
+checkout of the pre-striping tree) to interleave baseline cells into the
+same artifact: baseline and current cells alternate within one run, so
+host drift between rounds cannot fake a win. Evidence for the striping
+PR lives under docs/evidence/bench_transport_stripe_*.json.
 """
 
 from __future__ import annotations
@@ -21,16 +38,57 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import subprocess
 import sys
 import time
-from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 
+_REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_REPO))
+
+from torchft_tpu.comm import StoreServer  # noqa: E402
+
+# Rank worker, exec'd as `python -c` so a baseline tree's transport can be
+# measured by inserting THAT tree on sys.path — no imports leak between
+# versions. Prints one JSON line (rank 0: latencies + lane balance).
+_WORKER = r"""
+import json, sys, time
+spec = json.loads(sys.argv[1])
+sys.path.insert(0, spec["tree"])
 import numpy as np
+from torchft_tpu.comm.transport import TcpCommContext
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+ctx = TcpCommContext(
+    timeout=30.0, algorithm=spec["algorithm"], channels=spec["channels"],
+    **spec["extra"],
+)
+ctx.configure(spec["store"], spec["rank"], spec["world"])
+data = np.empty(spec["nbytes"] // 4, dtype=np.float32)
+fill = np.float32(spec["rank"] + 1)
+lat = []
+for i in range(spec["warmup"] + spec["iters"]):
+    # allreduce reduces IN PLACE (donation contract): refill each
+    # iteration outside the timed region, mirroring the DDP arena pack.
+    data.fill(fill)
+    t0 = time.perf_counter()
+    ctx.allreduce([data]).future().result(timeout=30)
+    if spec["rank"] == 0 and i >= spec["warmup"]:
+        lat.append(time.perf_counter() - t0)
+if spec["rank"] == 0:
+    snap = ctx.metrics.snapshot()
+    lanes = [
+        v for k, v in snap.items()
+        if k.startswith("comm_l") and k.endswith("_wire_reduce_avg_ms")
+    ]
+    balance = (
+        max(lanes) / (sum(lanes) / len(lanes))
+        if len(lanes) >= 2 and any(lanes) else None
+    )
+    print(json.dumps({"lat": lat, "lane_balance": balance}))
+ctx.shutdown()
+"""
 
-from torchft_tpu.comm import StoreServer, TcpCommContext  # noqa: E402
+_CELL_SEQ = [0]
 
 
 def _percentiles(vals):
@@ -44,86 +102,232 @@ def _percentiles(vals):
     }
 
 
-def _bench_config(store, algorithm, world, channels, nbytes, iters, warmup):
-    """One (algorithm, world, channels, payload) cell; returns rank-0
-    latency percentiles."""
-    prefix = f"bt_{algorithm}_{world}_{channels}_{nbytes}"
-    ctxs = [
-        TcpCommContext(timeout=30.0, algorithm=algorithm, channels=channels)
-        for _ in range(world)
-    ]
-    n_elems = nbytes // 4
-    lat = []
+def _bench_config(store, algorithm, world, channels, nbytes, iters, warmup,
+                  tree=None, **extra):
+    """One (tree, algorithm, world, channels, extra-ctx-kwargs) cell;
+    returns rank-0 latency percentiles + lane balance."""
+    _CELL_SEQ[0] += 1
+    prefix = f"bt{_CELL_SEQ[0]}"
+    procs = []
+    for rank in range(world):
+        spec = {
+            "tree": str(tree or _REPO),
+            "store": f"{store.addr}/{prefix}",
+            "rank": rank, "world": world,
+            "algorithm": algorithm, "channels": channels,
+            "nbytes": nbytes, "iters": iters, "warmup": warmup,
+            "extra": extra,
+        }
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER, json.dumps(spec)],
+            stdout=subprocess.PIPE if rank == 0 else subprocess.DEVNULL,
+        ))
+    out, _ = procs[0].communicate(timeout=300)
+    for p in procs[1:]:
+        p.wait(timeout=60)
+    if procs[0].returncode != 0:
+        raise RuntimeError(f"cell {prefix} rank 0 failed")
+    payload = json.loads(out.decode().strip().splitlines()[-1])
+    res = _percentiles(payload["lat"])
+    balance = payload.get("lane_balance")
+    res["lane_balance"] = None if balance is None else round(balance, 3)
+    return res
 
-    def _worker(rank):
-        ctx = ctxs[rank]
-        ctx.configure(f"{store.addr}/{prefix}", rank, world)
-        # allreduce reduces IN PLACE (donation contract), so the staging
-        # buffer must be refilled each iteration — outside the timed
-        # region, mirroring the DDP arena's pack step.
-        data = np.empty(n_elems, dtype=np.float32)
-        fill = np.float32(rank + 1)
-        for i in range(warmup + iters):
-            data.fill(fill)
-            t0 = time.perf_counter()
-            ctx.allreduce([data]).future().result(timeout=30)
-            if rank == 0 and i >= warmup:
-                lat.append(time.perf_counter() - t0)
 
-    with ThreadPoolExecutor(max_workers=world) as pool:
-        for f in [pool.submit(_worker, r) for r in range(world)]:
-            f.result(timeout=120)
-    for ctx in ctxs:
-        ctx.shutdown()
-    return _percentiles(lat)
+def _finish_cell(res, nbytes, **tags) -> dict:
+    cell = {
+        **tags,
+        "payload_bytes": nbytes,
+        **{
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in res.items()
+        },
+    }
+    # star moves B up + B down on the root link; ring moves
+    # 2B(n-1)/n per link. Report payload/latency goodput.
+    cell["gbps"] = round(2 * nbytes / (res["avg_ms"] / 1e3) / 1e9, 3)
+    return cell
+
+
+def _stripe_sweep(store, payload_mb: int, iters_override,
+                  baseline_tree=None) -> list:
+    """chunk size x channels x codec grid at one payload, star and ring.
+    channels=1 rows are the single-lane baseline of the CURRENT tree;
+    tree="baseline" rows (with --ab-baseline) are the pre-striping
+    transport, interleaved cell-for-cell against the striped ones."""
+    nbytes = payload_mb << 20
+    iters = iters_override or 12
+    cells = []
+
+    def run(algorithm, world, channels, tree=None, **extra):
+        res = _bench_config(
+            store, algorithm, world, channels, nbytes,
+            iters=iters, warmup=3, tree=tree, **extra,
+        )
+        cell = _finish_cell(
+            res, nbytes,
+            tree="baseline" if tree else "current",
+            algorithm=algorithm, world=world, channels=channels,
+            iters=iters, **{
+                k: (v >> 10 if k == "chunk_bytes" else v)
+                for k, v in extra.items()
+            },
+        )
+        if "chunk_bytes" in extra:
+            cell["chunk_kb"] = cell.pop("chunk_bytes")
+        cells.append(cell)
+        print(
+            f"# {'BASE' if tree else 'new '} {algorithm} w{world} "
+            f"c{channels} {extra or ''}: avg {cell['avg_ms']}ms "
+            f"p50 {cell['p50_ms']}ms bal {cell['lane_balance']}",
+            file=sys.stderr,
+        )
+        return cell
+
+    for algorithm, world in (("star", 2), ("ring", 3)):
+        # Interleave: baseline / single-lane current / striped grid, so
+        # slow host drift hits all arms equally.
+        if baseline_tree:
+            run(algorithm, world, 1, tree=baseline_tree)
+        run(algorithm, world, 1, chunk_bytes=0)  # whole-payload, 1 lane
+        if baseline_tree:
+            run(algorithm, world, 4, tree=baseline_tree)  # PR1 default
+        for codec in ("none", "bf16", "int8"):
+            for chunk_kb in (1024, 4096):
+                for channels, stripe in ((2, True), (4, True), (4, False)):
+                    run(
+                        algorithm, world, channels,
+                        chunk_bytes=chunk_kb << 10, compression=codec,
+                        stripe=stripe,
+                    )
+    return cells
+
+
+def _ab_focus(store, payload_mb: int, iters_override, baseline_tree,
+              reps: int) -> list:
+    """Tight A/B on the acceptance-criterion cells only: PR1 single-lane
+    vs striped, alternated rep-for-rep (this host's load drifts on a
+    minutes scale — run-level A/Bs swing 2x, so pairs must interleave).
+    Per config the artifact carries every rep plus the median-of-reps
+    avg, the honest summary under load spikes."""
+    nbytes = payload_mb << 20
+    iters = iters_override or 10
+    configs = []
+    for algorithm, world in (("star", 2), ("ring", 3)):
+        configs += [
+            dict(algorithm=algorithm, world=world, channels=1,
+                 tree=baseline_tree, label=f"{algorithm}_base_c1"),
+            dict(algorithm=algorithm, world=world, channels=2,
+                 chunk_bytes=1 << 20, label=f"{algorithm}_striped_c2"),
+            dict(algorithm=algorithm, world=world, channels=4,
+                 chunk_bytes=1 << 20, label=f"{algorithm}_striped_c4"),
+            dict(algorithm=algorithm, world=world, channels=4,
+                 chunk_bytes=4 << 20, label=f"{algorithm}_striped_c4_4mb"),
+        ]
+    runs = {c["label"]: [] for c in configs}
+    for rep in range(reps):
+        for c in configs:
+            kw = {k: v for k, v in c.items()
+                  if k not in ("label", "algorithm", "world", "channels",
+                               "tree")}
+            res = _bench_config(
+                store, c["algorithm"], c["world"], c["channels"], nbytes,
+                iters=iters, warmup=3, tree=c.get("tree"), **kw,
+            )
+            runs[c["label"]].append(res)
+            print(
+                f"# rep{rep} {c['label']}: avg {res['avg_ms']:.1f}ms "
+                f"p50 {res['p50_ms']:.1f}ms",
+                file=sys.stderr,
+            )
+    cells = []
+    for c in configs:
+        reps_res = runs[c["label"]]
+        avgs = sorted(r["avg_ms"] for r in reps_res)
+        cells.append({
+            "label": c["label"],
+            "tree": "baseline" if c.get("tree") else "current",
+            "algorithm": c["algorithm"], "world": c["world"],
+            "channels": c["channels"],
+            "chunk_kb": (c.get("chunk_bytes", 0) >> 10) or None,
+            "payload_bytes": nbytes, "iters": iters, "reps": reps,
+            "median_avg_ms": round(avgs[len(avgs) // 2], 3),
+            "min_avg_ms": round(avgs[0], 3),
+            "rep_avg_ms": [round(a, 3) for a in avgs],
+            "lane_balance": reps_res[-1]["lane_balance"],
+        })
+    return cells
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true", help="add 32MB payloads")
     ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument(
+        "--stripe-sweep", action="store_true",
+        help="chunk size x lanes x codec grid at a fixed payload",
+    )
+    ap.add_argument("--sweep-payload-mb", type=int, default=8)
+    ap.add_argument(
+        "--ab-baseline", default=None, metavar="TREE",
+        help="path to a pre-striping checkout; interleaves its cells "
+        "into the --stripe-sweep artifact for a same-run A/B",
+    )
+    ap.add_argument(
+        "--ab-repeat", type=int, default=0, metavar="N",
+        help="with --ab-baseline: run ONLY the acceptance-criterion "
+        "cells (PR1 single-lane vs striped), alternated N times",
+    )
     args = ap.parse_args()
 
-    sizes = [64 << 10, 1 << 20, 8 << 20]
-    if args.full:
-        sizes.append(32 << 20)
     cells = []
     t_start = time.perf_counter()
     store = StoreServer()
     try:
-        for nbytes in sizes:
-            iters = args.iters or max(5, min(30, (8 << 20) // nbytes * 4))
-            for algorithm, world in (("star", 2), ("ring", 3)):
-                for channels in (1, 4):
-                    res = _bench_config(
-                        store, algorithm, world, channels, nbytes,
-                        iters=iters, warmup=3,
-                    )
-                    cell = {
-                        "algorithm": algorithm,
-                        "world": world,
-                        "channels": channels,
-                        "payload_bytes": nbytes,
-                        "iters": iters,
-                        **{k: round(v, 3) for k, v in res.items()},
-                    }
-                    # star moves B up + B down on the root link; ring moves
-                    # 2B(n-1)/n per link. Report payload/latency goodput.
-                    cell["gbps"] = round(
-                        2 * nbytes / (res["avg_ms"] / 1e3) / 1e9, 3
-                    )
-                    cells.append(cell)
-                    print(
-                        f"# {algorithm} w{world} c{channels} "
-                        f"{nbytes >> 10}KB: avg {cell['avg_ms']}ms "
-                        f"p95 {cell['p95_ms']}ms",
-                        file=sys.stderr,
-                    )
+        if args.ab_repeat and args.ab_baseline:
+            cells = _ab_focus(
+                store, args.sweep_payload_mb, args.iters,
+                args.ab_baseline, args.ab_repeat,
+            )
+        elif args.stripe_sweep:
+            cells = _stripe_sweep(
+                store, args.sweep_payload_mb, args.iters,
+                baseline_tree=args.ab_baseline,
+            )
+        else:
+            sizes = [64 << 10, 1 << 20, 8 << 20]
+            if args.full:
+                sizes.append(32 << 20)
+            for nbytes in sizes:
+                iters = args.iters or max(5, min(30, (8 << 20) // nbytes * 4))
+                for algorithm, world in (("star", 2), ("ring", 3)):
+                    for channels in (1, 4):
+                        res = _bench_config(
+                            store, algorithm, world, channels, nbytes,
+                            iters=iters, warmup=3,
+                        )
+                        cell = _finish_cell(
+                            res, nbytes,
+                            algorithm=algorithm, world=world,
+                            channels=channels, iters=iters,
+                        )
+                        cells.append(cell)
+                        print(
+                            f"# {algorithm} w{world} c{channels} "
+                            f"{nbytes >> 10}KB: avg {cell['avg_ms']}ms "
+                            f"p95 {cell['p95_ms']}ms",
+                            file=sys.stderr,
+                        )
     finally:
         store.shutdown()
 
     print(json.dumps({
-        "bench": "transport_loopback_allreduce",
+        "bench": (
+            "transport_stripe_ab" if args.ab_repeat and args.ab_baseline
+            else "transport_stripe_sweep" if args.stripe_sweep
+            else "transport_loopback_allreduce"
+        ),
+        "workers": "process-per-rank",
         "wall_s": round(time.perf_counter() - t_start, 1),
         "cells": cells,
     }))

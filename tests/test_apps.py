@@ -163,6 +163,7 @@ def test_train_hsdp_example_donated_update() -> None:
     assert "step 3" in proc.stdout, proc.stdout
 
 
+@pytest.mark.slow  # tier-1 budget: >=25s on a 2-core host (see pytest.ini)
 def test_train_ddp_example_durable_resume(tmp_path) -> None:
     # The DDP example's durable checkpoints are written by the async
     # writer; a second run with the same CKPT_PATH must resume from the
@@ -200,6 +201,7 @@ def test_train_ddp_example_durable_resume(tmp_path) -> None:
     )
 
 
+@pytest.mark.slow  # tier-1 budget: >=25s on a 2-core host (see pytest.ini)
 def test_train_llama_ring_example_runs() -> None:
     # Llama (GQA/RoPE/SwiGLU) x ring attention (sequence parallelism)
     # x chunked CE x FT manager, end-to-end as a real subprocess — the

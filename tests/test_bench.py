@@ -55,6 +55,7 @@ def _last_line_json(out):
         )
 
 
+@pytest.mark.slow  # tier-1 budget: >=25s on a 2-core host (see pytest.ini)
 def test_bench_tail_is_json_through_chaos_teardown():
     """The full 2-replica chaos path — child SIGKILL, warm-standby rejoin,
     heal, multi-server teardown — must still end with one JSON line."""
@@ -153,6 +154,7 @@ def test_bench_error_path_still_emits_json():
     assert "value" in payload and "vs_baseline" in payload
 
 
+@pytest.mark.slow  # tier-1 budget: >=25s on a 2-core host (see pytest.ini)
 def test_bench_wedged_probe_fallback_survives_watchdog():
     """r3's graded artifact was destroyed by the watchdog firing while the
     parent legitimately waited on the probe / CPU-fallback child
@@ -193,6 +195,7 @@ def test_bench_wedged_probe_fallback_survives_watchdog():
     assert out.returncode == 0
 
 
+@pytest.mark.slow  # tier-1 budget: >=25s on a 2-core host (see pytest.ini)
 def test_bench_flagship_cpu_smoke():
     """The 125m flagship config must run in the graded loop (full param
     set, real vocab, real bucketing shapes) even when only a CPU is
@@ -218,6 +221,7 @@ def test_bench_flagship_cpu_smoke():
     assert payload["value"] > 0
 
 
+@pytest.mark.slow  # tier-1 budget: >=25s on a 2-core host (see pytest.ini)
 def test_bench_localsgd_diloco_fields():
     """BASELINE configs 3-4 ride the graded artifact: LocalSGD with a
     real injected transport fault (discarded sync + recovery through the

@@ -30,6 +30,7 @@ logger = logging.getLogger(__name__)
 pytest.importorskip("flax")
 
 
+@pytest.mark.slow  # tier-1 budget: >=25s on a 2-core host (see pytest.ini)
 def test_resnet18_ddp_two_groups_kill_and_heal() -> None:
     from torchft_tpu.models.resnet import create_resnet18
 

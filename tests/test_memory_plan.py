@@ -55,6 +55,7 @@ def _mem(compiled):
     return ma
 
 
+@pytest.mark.slow  # tier-1 budget: >=25s on a 2-core host (see pytest.ini)
 def test_fused_ft_step_donates_params_and_opt() -> None:
     """The fused commit path (bench T1 / OptimizerWrapper.fused_step)
     must alias params+opt into its outputs: peak HBM matches the
@@ -76,6 +77,7 @@ def test_fused_ft_step_donates_params_and_opt() -> None:
     )
 
 
+@pytest.mark.slow  # tier-1 budget: >=25s on a 2-core host (see pytest.ini)
 def test_classic_update_doubling_and_donated_fix() -> None:
     """The non-donated optax update (OptimizerWrapper._update, the
     overlapped classic path) transiently allocates a fresh params+opt for

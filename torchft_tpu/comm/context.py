@@ -135,6 +135,41 @@ class CommContext(ABC):
         """Latched transport error, if any (cleared by configure)."""
         return None
 
+    # ----------------------------------------------- wire introspection
+    # Implementations with a real wire (TcpCommContext) override these;
+    # the defaults describe an identity wire. Consumers: the DDP
+    # error-feedback arena (torchft_tpu/ddp.py) keys its residual
+    # lifecycle off codec lossiness and the generation counter.
+
+    def wire_codec_name(self) -> str:
+        """Name of the ALLREDUCE wire codec ("none" when the wire does
+        not transform payloads)."""
+        return "none"
+
+    def wire_is_lossy(self) -> bool:
+        """True when the allreduce wire codec loses precision (bf16/fp16/
+        int8) — the condition under which error feedback pays."""
+        return False
+
+    def wire_compensable(self) -> bool:
+        """True when THIS rank's allreduce contribution crosses the wire
+        through the lossy codec (role-aware: star peers only) — the gate
+        for running the error-feedback arena at all. Identity wire:
+        never."""
+        return False
+
+    def wire_generation(self) -> int:
+        """Monotonic transport incarnation (bumped by configure). Wire-
+        derived step-persistent state — error-feedback residuals — must
+        reset when this changes."""
+        return 0
+
+    def wire_roundtrip(self, src: np.ndarray, out: np.ndarray) -> None:
+        """Write the wire's local image of ``src`` (decode(encode(src)),
+        chunked exactly as an allreduce payload would be) into ``out``.
+        Identity wire: a plain copy."""
+        np.copyto(out, src)
+
 
 class DummyCommContext(CommContext):
     """World-size-1 context that completes every op with its own inputs —
@@ -232,6 +267,21 @@ class ErrorSwallowingCommContext(CommContext):
     def shutdown(self) -> None:
         self._inner.shutdown()
 
+    def wire_codec_name(self) -> str:
+        return self._inner.wire_codec_name()
+
+    def wire_is_lossy(self) -> bool:
+        return self._inner.wire_is_lossy()
+
+    def wire_compensable(self) -> bool:
+        return self._inner.wire_compensable()
+
+    def wire_generation(self) -> int:
+        return self._inner.wire_generation()
+
+    def wire_roundtrip(self, src: np.ndarray, out: np.ndarray) -> None:
+        self._inner.wire_roundtrip(src, out)
+
 
 class ManagedCommContext(CommContext):
     """Context that routes every collective through a Manager so errors and
@@ -268,3 +318,18 @@ class ManagedCommContext(CommContext):
 
     def rank(self) -> int:
         return self._manager.participating_rank() or 0
+
+    def wire_codec_name(self) -> str:
+        return self._manager.wire_codec_name()
+
+    def wire_is_lossy(self) -> bool:
+        return self._manager.wire_is_lossy()
+
+    def wire_compensable(self) -> bool:
+        return self._manager.wire_compensable()
+
+    def wire_generation(self) -> int:
+        return self._manager.wire_generation()
+
+    def wire_roundtrip(self, src: np.ndarray, out: np.ndarray) -> None:
+        self._manager.wire_roundtrip(src, out)

@@ -157,9 +157,12 @@ class SubprocessCommContext(CommContext):
 
     def __init__(self, timeout: "float | timedelta" = 60.0,
                  algorithm: str = "auto", channels: int = 4,
-                 compression: str = "none") -> None:
-        """``algorithm``/``channels``/``compression`` are forwarded to the
-        child's TcpCommContext (see transport.py for their semantics)."""
+                 compression: str = "none",
+                 chunk_bytes: int = 1 << 20,
+                 stripe: bool = True) -> None:
+        """``algorithm``/``channels``/``compression``/``chunk_bytes``/
+        ``stripe`` are forwarded to the child's TcpCommContext (see
+        transport.py for their semantics)."""
         super().__init__()
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
@@ -168,6 +171,8 @@ class SubprocessCommContext(CommContext):
             "algorithm": algorithm,
             "channels": channels,
             "compression": compression,
+            "chunk_bytes": chunk_bytes,
+            "stripe": stripe,
         }
         self._mp = mp.get_context("spawn")
         self._epoch: Optional[_Epoch] = None

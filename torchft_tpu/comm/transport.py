@@ -34,6 +34,26 @@ reduction is in place. The caller DONATES the arrays it submits: the
 returned future resolves to arrays that may alias the inputs (reduced in
 place); after a transport error their contents are unspecified, which is
 fine because an errored step never commits (manager error latching).
+
+Chunk-striped allreduce: an ALLREDUCE payload is split into a
+deterministic chunk grid (contiguous <= ``chunk_bytes`` slices of each
+flat view, in view order) and chunk c is executed on lane
+``(base + c) % channels`` where ``base`` is the op's round-robin index —
+the same grid and the same chunk->lane map on every rank, so each lane's
+frame stream stays ordered exactly as in the one-op-one-lane model. A
+multi-megabyte DDP bucket therefore rides ALL lanes concurrently instead
+of serializing on one socket while the others idle. Each involved lane
+runs an independent sub-op over its chunk subset (star: per-chunk
+length-prefixed frames, upload and replies interleaved by the
+select-driven ``_duplex_exchange`` so chunk k+1 encodes/ships while the
+root still reduces chunk k; ring: the reduce-scatter/all-gather pair
+over the lane's chunk views, hops through the same duplex loop — no
+thread spawn per hop), and a shared op state resolves the caller's
+future when the last lane finishes. Because the star root drains peers in rank order PER CHUNK and
+the ring treats each chunk view as an independent payload, the reduced
+values are bitwise identical to running the same chunk grid on a single
+lane — striping changes only where bytes travel, never what is computed
+(tests/test_transport_striping.py pins this for every codec).
 """
 
 from __future__ import annotations
@@ -115,6 +135,141 @@ def _sendmsg_all(sock: socket.socket, bufs: Sequence) -> None:
                 sent = 0
 
 
+def _duplex_exchange(tx_sock: socket.socket, tx_bufs: Sequence,
+                     rx_sock: socket.socket, rx_targets,
+                     timeout: float) -> None:
+    """Single-threaded full-duplex exchange: stream ``tx_bufs`` (an iovec
+    list) to ``tx_sock`` while filling the memoryviews yielded by the
+    ``rx_targets`` generator from ``rx_sock``, interleaved via select.
+
+    This replaces the sender-thread-per-exchange pattern: same
+    deadlock-freedom (receives always drain, so the peer's sends always
+    progress), none of the thread spawn/GIL-handoff cost — which
+    dominated on oversubscribed hosts once striping multiplied the
+    number of concurrent exchanges. ``rx_targets`` may yield each next
+    buffer lazily (e.g. parse a header to size the payload slot);
+    ``tx_sock`` and ``rx_sock`` may be the same socket (star peer)."""
+    mvs = [mv for mv in (_as_bytes_view(b) for b in tx_bufs) if len(mv)]
+    sender: Optional[threading.Thread] = None
+    send_err: List[Optional[Exception]] = [None]
+    if not _HAS_SENDMSG:  # pragma: no cover — non-Linux fallback
+        # sendall-to-completion before receiving would deadlock once both
+        # sides' payloads exceed the socket buffers — keep the old
+        # sender-thread shape on platforms without sendmsg.
+        def _send_all() -> None:
+            try:
+                _sendmsg_all(tx_sock, mvs)
+            except Exception as e:  # noqa: BLE001
+                send_err[0] = e
+
+        sender = threading.Thread(target=_send_all, daemon=True)
+        sender.start()
+        mvs = []
+    rx_mv: Optional[memoryview] = None
+    rx_off = 0
+
+    def _advance_rx() -> None:
+        nonlocal rx_mv, rx_off
+        rx_off = 0
+        rx_mv = next(rx_targets, None)
+        while rx_mv is not None and len(rx_mv) == 0:
+            rx_mv = next(rx_targets, None)
+
+    _advance_rx()
+    if not mvs and rx_mv is None:
+        if sender is not None:  # pragma: no cover — non-Linux fallback
+            sender.join(timeout=timeout)
+            if send_err[0] is not None:
+                raise send_err[0]
+            if sender.is_alive():
+                raise TimeoutError("duplex exchange send stalled")
+        return
+    import select as _select
+
+    # Idle deadline, not wall-clock: extended on every byte of progress,
+    # matching the old per-syscall timeout semantics — a slow link that
+    # keeps moving data must not fail a large exchange.
+    deadline = time.perf_counter() + timeout
+    # With a sender thread (non-sendmsg fallback) the select phase has
+    # nothing to send — and toggling the tx socket non-blocking under
+    # the thread's in-flight sendall would make it crash with
+    # BlockingIOError. Leave every socket in timeout mode there.
+    socks = {tx_sock, rx_sock} if sender is None else set()
+    for s in socks:
+        s.setblocking(False)
+    try:
+        # Interleave only while there is still something to SEND — that
+        # is the window where a blocking receive could deadlock (both
+        # sides wedged in sends against full buffers). Once tx drains,
+        # fall through to plain blocking receives: half the wakeups, and
+        # each one can sleep through GIL contention with in-process
+        # compute (jax dispatch) instead of re-waking per TCP segment —
+        # measured as a 3x allreduce_p50 regression in bench.py when the
+        # select loop ran the whole exchange.
+        while mvs:
+            now = time.perf_counter()
+            if now > deadline:
+                raise TimeoutError("duplex exchange stalled")
+            rlist = [rx_sock] if rx_mv is not None else []
+            wlist = [tx_sock]
+            r, w, _ = _select.select(
+                rlist, wlist, [], min(1.0, deadline - now)
+            )
+            if w:
+                # Drain until the buffer fills — one select round can
+                # ship many chunks; re-selecting per sendmsg doubled the
+                # syscall count on fast loopback paths.
+                while mvs:
+                    try:
+                        sent = tx_sock.sendmsg(mvs[:_IOV_MAX])
+                    except (BlockingIOError, InterruptedError):
+                        break
+                    if sent == 0:
+                        raise ConnectionError(
+                            "comm transport connection closed"
+                        )
+                    deadline = time.perf_counter() + timeout
+                    while sent and mvs:
+                        if sent >= len(mvs[0]):
+                            sent -= len(mvs[0])
+                            mvs.pop(0)
+                        else:
+                            mvs[0] = mvs[0][sent:]
+                            sent = 0
+            if r:
+                while rx_mv is not None:
+                    try:
+                        n = rx_sock.recv_into(
+                            rx_mv[rx_off:],
+                            min(len(rx_mv) - rx_off, 1 << 20),
+                        )
+                    except (BlockingIOError, InterruptedError):
+                        break
+                    if n == 0:
+                        raise ConnectionError(
+                            "comm transport connection closed"
+                        )
+                    deadline = time.perf_counter() + timeout
+                    rx_off += n
+                    if rx_off == len(rx_mv):
+                        _advance_rx()
+        # tx drained — finish the remaining receives blocking (the
+        # socket timeout bounds each recv, i.e. idle time, not total).
+        rx_sock.settimeout(timeout)
+        while rx_mv is not None:
+            _recv_into_exact(rx_sock, rx_mv[rx_off:])
+            _advance_rx()
+        if sender is not None:  # pragma: no cover — non-Linux fallback
+            sender.join(timeout=timeout)
+            if send_err[0] is not None:
+                raise send_err[0]
+            if sender.is_alive():
+                raise TimeoutError("duplex exchange send stalled")
+    finally:
+        for s in socks:
+            s.settimeout(timeout)
+
+
 def _recv_into_exact(sock: socket.socket, mv: memoryview) -> None:
     got, n = 0, len(mv)
     while got < n:
@@ -164,12 +319,28 @@ class _RecvBufs:
     def recv_payload(self, sock: socket.socket, n: int) -> memoryview:
         if n == 0:
             return memoryview(b"")
+        mv = self.payload_slot(n)
+        _recv_into_exact(sock, mv)
+        return mv
+
+    def payload_slot(self, n: int) -> memoryview:
+        """Rotate to the next payload slot and return its first ``n``
+        bytes WITHOUT receiving — for callers that fill it through the
+        select-driven duplex exchange instead of a blocking recv."""
         self._i ^= 1
         if len(self._slots[self._i]) < n:
             self._slots[self._i] = bytearray(n)
-        mv = memoryview(self._slots[self._i])[:n]
-        _recv_into_exact(sock, mv)
-        return mv
+        return memoryview(self._slots[self._i])[:n]
+
+    def header_slot(self, n: int) -> memoryview:
+        """First ``n`` bytes of the header scratch WITHOUT receiving
+        (duplex-exchange variant of recv_header)."""
+        if n > len(self._hdr):
+            raise ConnectionError(
+                f"oversized frame metadata ({n} bytes) — corrupt or "
+                "desynced stream"
+            )
+        return memoryview(self._hdr)[:n]
 
 
 def _array_frame_iovecs(arrays: Sequence[np.ndarray]) -> List:
@@ -290,17 +461,63 @@ def _recv_arrays(
     return out
 
 
+class _OpState:
+    """Completion state shared by one striped op's per-lane sub-ops: the
+    LAST lane to finish resolves the caller's future with the donated
+    arrays (reduced in place across all lanes' disjoint chunk views)."""
+
+    __slots__ = ("arrays", "fut", "_remaining", "_lock")
+
+    def __init__(self, arrays: List[np.ndarray], fut: Future,
+                 n_subops: int) -> None:
+        self.arrays = arrays
+        self.fut = fut
+        self._remaining = n_subops
+        self._lock = threading.Lock()
+
+    def subop_done(self) -> bool:
+        with self._lock:
+            self._remaining -= 1
+            return self._remaining == 0
+
+
 class _PendingOp:
-    __slots__ = ("opcode", "arrays", "op", "root", "fut", "t_submit")
+    __slots__ = ("opcode", "arrays", "op", "root", "fut", "t_submit",
+                 "chunks", "state")
 
     def __init__(self, opcode: int, arrays: List[np.ndarray], op: str,
-                 root: int, fut: Future) -> None:
+                 root: int, fut: Future,
+                 chunks: "Optional[List[np.ndarray]]" = None,
+                 state: "Optional[_OpState]" = None) -> None:
         self.opcode = opcode
         self.arrays = arrays
         self.op = op
         self.root = root
         self.fut = fut
+        self.chunks = chunks  # this lane's chunk views (striped allreduce)
+        self.state = state    # shared across the op's sub-ops
         self.t_submit = time.perf_counter()
+
+
+def _chunk_grid(flats: Sequence[np.ndarray],
+                chunk_bytes: int) -> List[np.ndarray]:
+    """Deterministic chunk grid over the op's flat views: each view is
+    split, in view order, into contiguous slices of at most
+    ``chunk_bytes`` (at least one element). chunk_bytes <= 0 keeps each
+    view whole (one chunk per view). Empty views contribute no chunks.
+    Built from shapes/dtypes only, so every rank computes the identical
+    grid — the precondition for the chunk->lane map to agree."""
+    chunks: List[np.ndarray] = []
+    for f in flats:
+        if f.size == 0:
+            continue
+        if chunk_bytes <= 0:
+            chunks.append(f)
+            continue
+        step = max(1, chunk_bytes // f.dtype.itemsize)
+        for s in range(0, f.size, step):
+            chunks.append(f[s: s + step])
+    return chunks
 
 
 # --------------------------------------------------------------- compression
@@ -539,13 +756,29 @@ class _Lane:
             try:
                 result = self._execute(pending)
                 t_exec = time.perf_counter()
-                pending.fut.set_result(result)
+                if pending.state is not None:
+                    # Striped sub-op: only the LAST lane resolves the
+                    # future (with the full donated array list — every
+                    # lane reduced its own disjoint chunk views in place).
+                    if pending.state.subop_done():
+                        try:
+                            pending.state.fut.set_result(
+                                pending.state.arrays
+                            )
+                        except Exception:
+                            pass  # a sibling lane already failed the op
+                else:
+                    pending.fut.set_result(result)
                 t_done = time.perf_counter()
                 if pending.opcode == _OP_ALLREDUCE:
                     # Allreduce only: these split bench's allreduce number
                     # along the transport's seams — a heal broadcast or
                     # allgather landing here would pin gradient-path
-                    # regressions on checkpoint traffic.
+                    # regressions on checkpoint traffic. Striped ops
+                    # observe once per SUB-op: the per-lane wire_reduce is
+                    # each lane's share of the op (their max approximates
+                    # the op's wire time; end-to-end latency is the
+                    # manager's `allreduce` timer).
                     metrics.observe(
                         "comm_submit_wire", t_deq - pending.t_submit
                     )
@@ -559,6 +792,10 @@ class _Lane:
                     self._rank, self._world_size, self._lane_id, e,
                 )
                 try:
+                    # Striped ops share one future: the first failing lane
+                    # fails it; a sibling's later set_result/set_exception
+                    # is swallowed by the guards (donation contract —
+                    # contents are unspecified after an error anyway).
                     pending.fut.set_exception(e)
                 except Exception:
                     pass
@@ -574,6 +811,20 @@ class _Lane:
         if self._world_size == 1:
             if p.opcode == _OP_ALLGATHER:
                 return [p.arrays]
+            return p.arrays
+
+        if p.opcode == _OP_ALLREDUCE:
+            # Chunked data path (see module docstring): this sub-op
+            # carries the lane's chunk views of the op's payload; every
+            # rank built the same grid, so the per-lane frame sequence
+            # matches peer for peer.
+            if self._use_ring:
+                self._ring_allreduce_chunks(p)
+            elif self._rank == 0:
+                self._star_allreduce_root_chunks(p)
+            else:
+                assert self._root_sock is not None
+                self._star_allreduce_peer_chunks(p, self._root_sock)
             return p.arrays
 
         if self._use_ring:
@@ -595,79 +846,98 @@ class _Lane:
                 f"seq={self._seq}"
             )
 
-    # Star ALLREDUCE frame (both directions): [nbytes u64] + the codec's
-    # raw encoded stream over the FLAT views of the op's arrays — shapes
-    # are known on both sides (allreduce requires identical layouts), so
-    # the self-describing _pack_arrays framing is skipped and the payload
-    # decodes straight into the caller's arrays via codec.decode_into
-    # (the ring path's interface, now shared). Reduction is IN PLACE on
-    # the donated p.arrays; peers are drained in sorted rank order so the
+    # Star ALLREDUCE frames (both directions): per chunk,
+    # [nbytes u64] + the codec's raw encoded stream over that chunk view —
+    # shapes are known on both sides (allreduce requires identical
+    # layouts), so the self-describing _pack_arrays framing is skipped and
+    # each chunk decodes straight into the caller's arrays via
+    # codec.decode_into. Reduction is IN PLACE on the donated chunk views;
+    # peers are drained in sorted rank order PER CHUNK, so the
     # accumulation order — hence the float result — is bitwise identical
-    # to the sequential r=1..n-1 reduction.
+    # to the sequential r=1..n-1 reduction of the whole payload, for any
+    # chunk grid and any chunk->lane distribution.
 
-    def _star_allreduce_root(self, p: _PendingOp) -> List[np.ndarray]:
+    def _star_allreduce_root_chunks(self, p: _PendingOp) -> None:
         codec = self._codec
         reduce_fn = _REDUCE_FNS.get(
             ReduceOp.SUM if p.op == ReduceOp.AVG else p.op
         )
         if reduce_fn is None:
             raise ValueError(f"unsupported reduce op: {p.op}")
-        flats = [a.reshape(-1) for a in p.arrays]
-        expected = sum(codec.wire_nbytes(v) for v in flats)
-        for peer_rank, sock in sorted(self._peer_socks.items()):
+        peers = sorted(self._peer_socks.items())
+        for peer_rank, sock in peers:
             self._check_header(peer_rank, sock, _OP_ALLREDUCE)
-            (nbytes,) = struct.unpack("<Q", self._bufs.recv_header(sock, 8))
-            if nbytes != expected:
-                raise ConnectionError(
-                    f"allreduce payload size mismatch from rank "
-                    f"{peer_rank}: {nbytes} != {expected} (divergent "
-                    "shapes?)"
+        copy = lambda v, inc: np.copyto(v, inc)  # noqa: E731
+        lossy = type(codec) is not _NoCodec
+        for ch in p.chunks:
+            expected = codec.wire_nbytes(ch)
+            for peer_rank, sock in peers:
+                (nbytes,) = struct.unpack(
+                    "<Q", self._bufs.recv_header(sock, 8)
                 )
-            payload = self._bufs.recv_payload(sock, nbytes)
-            # Streaming reduce: decoded straight into the accumulator,
-            # consumed before the next peer's receive reuses the slot.
-            codec.decode_into(payload, flats, reduce_fn)
-        if p.op == ReduceOp.AVG:
-            for f in flats:
-                np.divide(f, self._world_size, out=f)
-        # Fan out the ENCODED result; for a lossy codec the root then
-        # re-decodes its own encoded bytes so it sees values byte-identical
-        # to every peer (identity codec: the bytes ARE the accumulator's).
-        enc = codec.encode_iovecs(flats)
-        frame = [struct.pack("<Q", _iov_nbytes(enc)), *enc]
-        for _, sock in sorted(self._peer_socks.items()):
-            _sendmsg_all(sock, frame)
-        if type(codec) is not _NoCodec:
-            codec.decode_into(
-                _iov_join(enc), flats, lambda v, inc: np.copyto(v, inc)
-            )
-        return p.arrays
+                if nbytes != expected:
+                    raise ConnectionError(
+                        f"allreduce chunk size mismatch from rank "
+                        f"{peer_rank}: {nbytes} != {expected} (divergent "
+                        "shapes or chunk_bytes?)"
+                    )
+                payload = self._bufs.recv_payload(sock, nbytes)
+                # Streaming reduce: decoded straight into the accumulator,
+                # consumed before the next peer's receive reuses the slot.
+                codec.decode_into(payload, [ch], reduce_fn)
+            if p.op == ReduceOp.AVG:
+                np.divide(ch, self._world_size, out=ch)
+            # Fan out the ENCODED chunk as soon as it completes — peers
+            # decode chunk k while chunk k+1 is still streaming in. For a
+            # lossy codec the root then re-decodes its own encoded bytes
+            # so it sees values byte-identical to every peer (identity
+            # codec: the bytes ARE the accumulator's).
+            enc = codec.encode_iovecs([ch])
+            frame = [struct.pack("<Q", _iov_nbytes(enc)), *enc]
+            for _, sock in peers:
+                _sendmsg_all(sock, frame)
+            if lossy:
+                codec.decode_into(_iov_join(enc), [ch], copy)
 
-    def _star_allreduce_peer(
+    def _star_allreduce_peer_chunks(
         self, p: _PendingOp, sock: socket.socket
-    ) -> List[np.ndarray]:
+    ) -> None:
         codec = self._codec
-        flats = [a.reshape(-1) for a in p.arrays]
-        enc = codec.encode_iovecs(flats)
-        expected = _iov_nbytes(enc)
-        _sendmsg_all(sock, [
-            struct.pack("<BQB", _OP_ALLREDUCE, self._seq, 0),
-            struct.pack("<Q", expected),
-            *enc,
-        ])
-        (nbytes,) = struct.unpack("<Q", self._bufs.recv_header(sock, 8))
-        if nbytes != expected:
-            raise ConnectionError(
-                f"allreduce reply size mismatch: {nbytes} != {expected} "
-                "(divergent shapes?)"
-            )
-        payload = self._bufs.recv_payload(sock, nbytes)
-        codec.decode_into(payload, flats, lambda v, inc: np.copyto(v, inc))
-        return p.arrays
+        chunks = p.chunks
+        copy = lambda v, inc: np.copyto(v, inc)  # noqa: E731
+        # Software pipeline: encode every chunk up front as iovecs (the
+        # identity codec ships the chunk views themselves, zero copy;
+        # lossy codecs allocate per chunk, bounded by chunk_bytes), then
+        # stream the whole upload while pulling replies off the SAME
+        # socket in one select-driven loop — chunk k+1 ships while the
+        # root still reduces chunk k, replies drain as they land, and
+        # neither direction can deadlock on full socket buffers.
+        tx: List = [struct.pack("<BQB", _OP_ALLREDUCE, self._seq, 0)]
+        for ch in chunks:
+            enc = codec.encode_iovecs([ch])
+            tx.append(struct.pack("<Q", _iov_nbytes(enc)))
+            tx.extend(enc)
+
+        def _rx_targets():
+            for ch in chunks:
+                expected = codec.wire_nbytes(ch)
+                len_mv = self._bufs.header_slot(8)
+                yield len_mv
+                (nbytes,) = struct.unpack("<Q", len_mv)
+                if nbytes != expected:
+                    raise ConnectionError(
+                        f"allreduce reply chunk size mismatch: {nbytes} "
+                        f"!= {expected} (divergent shapes or chunk_bytes?)"
+                    )
+                payload = self._bufs.payload_slot(nbytes)
+                yield payload
+                # decode runs between fills — before the slot's next
+                # reuse, same contract as the blocking path
+                codec.decode_into(payload, [ch], copy)
+
+        _duplex_exchange(sock, tx, sock, _rx_targets(), self._timeout)
 
     def _execute_root(self, p: _PendingOp):
-        if p.opcode == _OP_ALLREDUCE:
-            return self._star_allreduce_root(p)
         contributions: Dict[int, List[np.ndarray]] = {0: p.arrays}
         for peer_rank, sock in sorted(self._peer_socks.items()):
             self._check_header(peer_rank, sock, p.opcode)
@@ -694,8 +964,6 @@ class _Lane:
     def _execute_peer(self, p: _PendingOp):
         sock = self._root_sock
         assert sock is not None
-        if p.opcode == _OP_ALLREDUCE:
-            return self._star_allreduce_peer(p, sock)
         if p.opcode == _OP_BROADCAST and self._rank != p.root:
             # Root discards non-root contributions for broadcast; send an
             # empty frame instead of the full payload.
@@ -731,8 +999,11 @@ class _Lane:
         self, opcode: int, step: int, bufs: Sequence, nbytes: int
     ) -> memoryview:
         """Full-duplex one-step exchange: push to next while pulling from
-        prev (a sender thread avoids deadlock once payloads exceed socket
-        buffers). Every frame carries [opcode][seq][step][nbytes] and the
+        prev, interleaved in THIS thread by the select-driven
+        _duplex_exchange (deadlock-free like the old sender-thread
+        version — receives always drain — without a thread spawn and the
+        GIL handoffs per hop, which striping would multiply by lanes x
+        chunks). Every frame carries [opcode][seq][step][nbytes] and the
         receiver validates it — a desynced collective sequence fails fast
         instead of silently reducing misaligned bytes (parity with the
         star path's mismatch check).
@@ -745,35 +1016,32 @@ class _Lane:
         while that hop's frame streams into the other slot."""
         next_sock, prev_sock = self._next_sock, self._prev_sock
         assert next_sock is not None and prev_sock is not None
-        send_err: List[Optional[Exception]] = [None]
         header = self._RING_HDR.pack(opcode, self._seq, step, nbytes)
+        hdr_size = self._RING_HDR.size
+        out: List[memoryview] = []
 
-        def _send() -> None:
-            try:
-                _sendmsg_all(next_sock, [header, *bufs])
-            except Exception as e:  # noqa: BLE001
-                send_err[0] = e
-
-        sender = threading.Thread(target=_send, daemon=True)
-        sender.start()
-        try:
-            r_op, r_seq, r_step, r_len = self._RING_HDR.unpack(
-                self._bufs.recv_header(prev_sock, self._RING_HDR.size)
-            )
+        def _rx_targets():
+            hdr_mv = self._bufs.header_slot(hdr_size)
+            yield hdr_mv
+            r_op, r_seq, r_step, r_len = self._RING_HDR.unpack(hdr_mv)
             if (r_op, r_seq, r_step) != (opcode, self._seq, step):
                 raise ConnectionError(
                     f"ring collective mismatch: got op={r_op} seq={r_seq} "
                     f"step={r_step}, expected op={opcode} seq={self._seq} "
                     f"step={step}"
                 )
-            data = self._bufs.recv_payload(prev_sock, r_len)
-        finally:
-            sender.join(timeout=self._timeout)
-        if send_err[0] is not None:
-            raise send_err[0]
-        if sender.is_alive():
-            raise TimeoutError("ring send stalled")
-        return data
+            if r_len == 0:
+                out.append(memoryview(b""))
+                return
+            payload = self._bufs.payload_slot(r_len)
+            out.append(payload)
+            yield payload
+
+        _duplex_exchange(
+            next_sock, [header, *bufs], prev_sock, _rx_targets(),
+            self._timeout,
+        )
+        return out[0]
 
     @staticmethod
     def _chunk_bounds(total: int, n: int, c: int) -> "tuple[int, int]":
@@ -785,8 +1053,6 @@ class _Lane:
 
     def _execute_ring(self, p: _PendingOp):
         n, r = self._world_size, self._rank
-        if p.opcode == _OP_ALLREDUCE:
-            return self._ring_allreduce(p)
         if p.opcode == _OP_BROADCAST:
             # forward whole payload around the ring, root first; frames
             # carry the seq header so desyncs fail fast
@@ -831,9 +1097,13 @@ class _Lane:
             return gathered
         raise ValueError(f"unknown opcode {p.opcode}")
 
-    def _ring_allreduce(self, p: _PendingOp):
-        """Bandwidth-optimal allreduce: reduce-scatter then all-gather,
-        2(n-1) steps moving ~1/n of the payload each."""
+    def _ring_allreduce_chunks(self, p: _PendingOp) -> None:
+        """Bandwidth-optimal allreduce over this lane's chunk views:
+        reduce-scatter then all-gather, 2(n-1) steps moving ~1/n of the
+        lane's payload each. Each grid chunk is an independent flat view
+        (split into n rank-parts via _chunk_bounds), so the per-element
+        accumulation order depends only on the grid — identical whether
+        the chunks run on one lane or are striped across many."""
         n, r = self._world_size, self._rank
         reduce_fn = _REDUCE_FNS.get(
             ReduceOp.SUM if p.op == ReduceOp.AVG else p.op
@@ -851,12 +1121,11 @@ class _Lane:
         # traffic).
         codec = self._codec
         rs_codec = _NO_CODEC
-        # In place on the donated arrays — no accumulator copy. Chunks
-        # are disjoint regions of `flats`, so the full-duplex send of
-        # chunk (r-s) never overlaps the concurrent receive+reduce of
-        # chunk (r-s-1).
-        out = p.arrays
-        flats = [a.reshape(-1) for a in out]
+        # In place on the donated chunk views — no accumulator copy.
+        # Rank-parts are disjoint regions of `flats`, so the full-duplex
+        # send of part (r-s) never overlaps the concurrent receive+reduce
+        # of part (r-s-1).
+        flats = p.chunks
 
         def chunk_views(c: int) -> List[np.ndarray]:
             views = []
@@ -922,7 +1191,6 @@ class _Lane:
         if p.op == ReduceOp.AVG:
             for f in flats:
                 np.divide(f, n, out=f)
-        return out
 
 
 class TcpCommContext(CommContext):
@@ -931,7 +1199,9 @@ class TcpCommContext(CommContext):
 
     def __init__(self, timeout: "float | timedelta" = 60.0,
                  algorithm: str = "auto", channels: int = 4,
-                 compression: str = "none") -> None:
+                 compression: str = "none",
+                 chunk_bytes: int = 1 << 20,
+                 stripe: bool = True) -> None:
         """``algorithm``: "star" (rank 0 reduces and fans out — lowest
         latency for tiny payloads / few replicas), "ring" (bandwidth-optimal
         reduce-scatter + all-gather: each link moves ~2B/n per allreduce
@@ -948,7 +1218,19 @@ class TcpCommContext(CommContext):
         ~1 byte/elem). Lossy codecs still yield IDENTICAL decoded values
         on every rank (encoded bytes are fanned out / forwarded
         verbatim), so replica trajectories stay consistent; allgather and
-        broadcast are never compressed. Must match across ranks."""
+        broadcast are never compressed. Must match across ranks.
+
+        ``chunk_bytes``: ALLREDUCE payloads are split into contiguous
+        chunks of at most this many bytes (per flat view; 0 keeps each
+        view whole). The chunk grid is also the lossy codecs' encode
+        granularity (int8 scales are per chunk) and, with ``stripe``, the
+        unit distributed across lanes. Must match across ranks.
+
+        ``stripe``: distribute one op's chunks across ALL lanes
+        (chunk c -> lane (base + c) % channels) so a single large payload
+        uses every socket concurrently; False pins every chunk to the
+        op's round-robin lane (the one-op-one-lane PR 1 model, kept as an
+        A/B lever for the bench). Must match across ranks."""
         super().__init__()
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
@@ -956,12 +1238,16 @@ class TcpCommContext(CommContext):
             raise ValueError(f"unknown algorithm {algorithm!r}")
         if channels < 1:
             raise ValueError("channels must be >= 1")
+        if chunk_bytes < 0:
+            raise ValueError("chunk_bytes must be >= 0")
         if compression not in _CODECS:
             raise ValueError(
                 f"unknown compression {compression!r}; "
                 f"have {sorted(_CODECS)}"
             )
         self._codec = _CODECS[compression]()
+        self._chunk_bytes = int(chunk_bytes)
+        self._stripe = bool(stripe)
         self._algorithm = algorithm
         self._channels = int(channels)
         self._use_ring = False
@@ -1195,6 +1481,71 @@ class TcpCommContext(CommContext):
             if self._error is None:
                 self._error = e
 
+    # ------------------------------------------------- wire introspection
+    # (CommContext API; the DDP error-feedback arena keys off these.)
+
+    def wire_codec_name(self) -> str:
+        return self._codec.name
+
+    def wire_is_lossy(self) -> bool:
+        return type(self._codec) is not _NoCodec
+
+    def wire_generation(self) -> int:
+        """Monotonic transport incarnation, bumped by every configure().
+        Step-persistent state derived from wire behavior (the DDP
+        error-feedback residuals) must be reset when this changes — a new
+        membership means the residual no longer describes error this
+        cohort saw."""
+        with self._lock:
+            return self._generation
+
+    def wire_compensable(self) -> bool:
+        """True when THIS rank's allreduce contribution actually crosses
+        the wire through a lossy codec — the precondition for an
+        error-feedback residual to describe anything real. Role-aware,
+        not just codec-aware: the star root's contribution is the
+        in-place accumulator (never encoded) and ring contributions ride
+        uncompressed partial sums, so only star PEERS are compensable.
+        Valid only after configure() for the current membership."""
+        with self._lock:
+            return (
+                type(self._codec) is not _NoCodec
+                and self._world_size > 1
+                and not self._use_ring
+                and self._rank != 0
+            )
+
+    def wire_roundtrip(self, src: np.ndarray, out: np.ndarray) -> None:
+        """Write the wire's image of THIS rank's allreduce contribution
+        into ``out`` — what an error-feedback residual must be computed
+        against, so it depends on topology and role, not just the codec:
+
+        * star peer: decode(encode(src)) per grid chunk — the
+          contribution crosses the wire quantized.
+        * star root: IDENTITY — the root's contribution is the in-place
+          accumulator itself and never rides the codec (compensating
+          "error" the wire never made would inject noise, measured as a
+          10x EF regression on the toy quadratic).
+        * ring: IDENTITY — reduce-scatter hops carry partial sums
+          uncompressed; the all-gather quantizes completed SUMS, a common
+          (all-ranks-identical) error no per-rank residual can describe.
+
+        Valid only after configure() for the current membership (DDP
+        calls it post-wait_quorum)."""
+        if src.shape != out.shape or src.dtype != out.dtype:
+            raise ValueError("wire_roundtrip: src/out layout mismatch")
+        if not self.wire_compensable():
+            np.copyto(out, src)
+            return
+        copy = lambda v, inc: np.copyto(v, inc)  # noqa: E731
+        codec = self._codec
+        src_chunks = _chunk_grid([src.reshape(-1)], self._chunk_bytes)
+        out_chunks = _chunk_grid([out.reshape(-1)], self._chunk_bytes)
+        for ch_s, ch_o in zip(src_chunks, out_chunks):
+            codec.decode_into(
+                _iov_join(codec.encode_iovecs([ch_s])), [ch_o], copy
+            )
+
     # ----------------------------------------------------------- collectives
 
     @staticmethod
@@ -1219,9 +1570,7 @@ class TcpCommContext(CommContext):
                 ConnectionError(f"comm context previously errored: {err}")
             )
             return Work(fut)
-        pending = _PendingOp(
-            opcode, [self._prepare(a) for a in arrays], op, root, fut
-        )
+        prepared = [self._prepare(a) for a in arrays]
         # Lock pairs with shutdown(): either we enqueue before the sentinel
         # (op will be drained) or we observe no lanes and fail fast.
         with self._lock:
@@ -1230,9 +1579,36 @@ class TcpCommContext(CommContext):
                     RuntimeError("comm context not configured")
                 )
                 return Work(fut)
-            lane = self._lanes[self._rr % len(self._lanes)]
+            n_lanes = len(self._lanes)
+            base = self._rr % n_lanes
             self._rr += 1
-            lane._queue.put(pending)
+            if opcode == _OP_ALLREDUCE and self._world_size > 1:
+                # Chunk-striped data path: deterministic grid + chunk->
+                # lane map (identical on every rank — see module
+                # docstring), one sub-op per involved lane sharing the
+                # op's future/state. stripe=False degenerates to the
+                # whole grid on the base lane.
+                chunks = _chunk_grid(
+                    [a.reshape(-1) for a in prepared], self._chunk_bytes
+                )
+                per_lane: Dict[int, List[np.ndarray]] = {}
+                for c, ch in enumerate(chunks):
+                    lane_id = (base + c) % n_lanes if self._stripe else base
+                    per_lane.setdefault(lane_id, []).append(ch)
+                if not per_lane:  # all views empty: nothing to reduce
+                    per_lane = {base: []}
+                state = _OpState(prepared, fut, len(per_lane))
+                self.metrics.incr("comm_chunks", float(len(chunks)))
+                if len(per_lane) > 1:
+                    self.metrics.incr("comm_striped_ops")
+                for lane_id in sorted(per_lane):
+                    self._lanes[lane_id]._queue.put(_PendingOp(
+                        opcode, prepared, op, root, fut,
+                        chunks=per_lane[lane_id], state=state,
+                    ))
+                return Work(fut)
+            pending = _PendingOp(opcode, prepared, op, root, fut)
+            self._lanes[base]._queue.put(pending)
         return Work(fut)
 
     def allreduce(

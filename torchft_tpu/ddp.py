@@ -20,6 +20,21 @@ into it in place (the comm-layer donation contract), and the result
 leaves are views of it until the H2D copy — no per-step bucket-sized
 allocation, no transport-side payload copies (docs/architecture.md, "Wire
 format and the zero-copy hot path").
+
+When the transport wire runs a lossy codec (bf16/int8), an ERROR-FEEDBACK
+arena rides alongside the staging arena: per float bucket, the
+quantization error of step t's transmitted contribution
+(e_t = g'_t - C(g'_t), computed against the wire's own chunk grid via
+``manager.wire_roundtrip``) persists in a host buffer and is added back
+into step t+1's gradients before encoding (g'_{t+1} = g_{t+1} + e_t).
+Every rank compensates its own contribution, so the quantization error
+becomes a delayed correction instead of a bias — the standard EF result
+that makes aggressive codecs (int8) converge like full precision, and
+what makes ``compression="int8"`` safe to enable by default for DDP
+gradient lanes. Residuals are RESET whenever ``manager.wire_generation``
+changes (every quorum membership change / transport reconfigure): a
+residual describes error owed to a specific cohort, and replaying it
+into a new quorum would inject stale gradient mass.
 """
 
 from __future__ import annotations
@@ -34,6 +49,13 @@ from torchft_tpu.futures import future_chain
 __all__ = ["DistributedDataParallel", "PureDistributedDataParallel"]
 
 _DEFAULT_BUCKET_BYTES = 32 * 1024 * 1024
+
+
+def _ef_dtype(dt: np.dtype) -> bool:
+    """Buckets the wire codecs actually compress (transport
+    _is_compressible) — integer buckets pass through losslessly, so they
+    carry no residual."""
+    return dt in (np.float32, np.float64)
 
 
 class _BucketPlan:
@@ -125,15 +147,53 @@ class _BucketPlan:
 
 
 class DistributedDataParallel:
-    """Bucketed fault-tolerant gradient averager (ref ddp.py:32-71)."""
+    """Bucketed fault-tolerant gradient averager (ref ddp.py:32-71).
 
-    def __init__(self, manager, bucket_bytes: int = _DEFAULT_BUCKET_BYTES) -> None:
+    ``error_feedback``: "auto" (default) enables the per-bucket residual
+    compensation exactly when the manager's wire codec is lossy; True
+    forces the arena on (still a no-op under an identity codec); False
+    disables it (raw quantization — expect drift under int8)."""
+
+    def __init__(self, manager, bucket_bytes: int = _DEFAULT_BUCKET_BYTES,
+                 error_feedback: "bool | str" = "auto") -> None:
+        if error_feedback not in (True, False, "auto"):
+            raise ValueError(
+                f"error_feedback must be True/False/'auto', "
+                f"got {error_feedback!r}"
+            )
         self._manager = manager
         self._bucket_bytes = bucket_bytes
+        self._error_feedback = error_feedback
         self._plan: "_BucketPlan | None" = None
         self._staging: "List[np.ndarray] | None" = None
+        self._residuals: "List[np.ndarray] | None" = None
+        self._ef_generation: "int | None" = None
         self._inflight: "Any | None" = None
         self._plan_lock = threading.Lock()
+
+    def _ef_active(self) -> bool:
+        """Error feedback applies when enabled AND this rank's
+        contribution actually crosses the wire through a lossy codec
+        (``wire_compensable`` — role-aware: a star root or ring member's
+        contribution is never encoded, so its residual would be
+        identically zero and the arena pure overhead) AND this replica is
+        contributing real gradients this step (healing / spare replicas
+        ship zeros — compensating those would bank the whole gradient as
+        'error' and replay it later)."""
+        if self._error_feedback is False:
+            return False
+        if self._error_feedback == "auto":
+            # True skips this gate (documented force semantics: the
+            # arena runs even where the roundtrip is an identity).
+            compensable = getattr(self._manager, "wire_compensable", None)
+            if callable(compensable):
+                if not compensable():
+                    return False
+            else:  # pre-striping manager: fall back to codec lossiness
+                lossy = getattr(self._manager, "wire_is_lossy", None)
+                if not callable(lossy) or not lossy():
+                    return False
+        return self._manager.is_participating()
 
     def _get_plan(self, host_leaves: List[np.ndarray]) -> _BucketPlan:
         with self._plan_lock:
@@ -223,6 +283,20 @@ class DistributedDataParallel:
         if self._staging is None:
             self._staging = plan.alloc_staging()
         staging = self._staging
+        ef = self._ef_active()
+        if ef:
+            # Residual arena lifecycle: (re)allocate zeroed on first use
+            # and on every transport incarnation change — membership
+            # changed, so step t-1's quantization error no longer belongs
+            # to this cohort's stream (docs/architecture.md, "Error
+            # feedback").
+            gen = self._manager.wire_generation()
+            if self._residuals is None or gen != self._ef_generation:
+                self._residuals = [
+                    np.zeros_like(s) if _ef_dtype(s.dtype) else None
+                    for s in staging
+                ]
+                self._ef_generation = gen
         works = []
         for k, bucket in enumerate(plan.buckets):
             with host_span(f"ddp_pack_bucket{k}"):
@@ -230,6 +304,25 @@ class DistributedDataParallel:
                     np.asarray(jax.device_get(leaves[i])) for i in bucket
                 ]
                 packed = plan.pack_bucket_into(bucket, host_b, staging[k])
+                if ef and self._residuals[k] is not None:
+                    res = self._residuals[k]
+                    # g' = g + e_{t-1}; then e_t = g' - C(g') where C is
+                    # the wire's own per-chunk quantizer — computed BEFORE
+                    # submit (the donated buffer is reduced in place, so
+                    # our transmitted contribution is unrecoverable after).
+                    np.add(packed, res, out=packed)
+                    self._manager.wire_roundtrip(packed, res)  # res = C(g')
+                    np.subtract(packed, res, out=res)
+                    if not np.all(np.isfinite(res)):
+                        # A non-finite gradient poisons its wire image
+                        # (int8 NaN-scale poisoning, bf16 inf-inf) and the
+                        # step is discarded by the commit gate — but the
+                        # residual persists. Left NaN it would re-inject
+                        # the spike into EVERY later step's gradients
+                        # until a membership change; drop that error
+                        # instead (one step of lost compensation).
+                        np.nan_to_num(res, copy=False,
+                                      nan=0.0, posinf=0.0, neginf=0.0)
             works.append(self._manager.allreduce_arrays([packed]))
 
         def _finish(_f) -> Any:

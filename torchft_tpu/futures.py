@@ -14,6 +14,7 @@ they carry host-side control-plane and DCN-transport results only.
 
 from __future__ import annotations
 
+import concurrent.futures
 import heapq
 import itertools
 import threading
@@ -142,7 +143,20 @@ def future_timeout(fut: "Future[T]", timeout: "float | timedelta") -> "Future[T]
 def future_wait(fut: "Future[T]", timeout: "float | timedelta") -> T:
     """Block on ``fut`` up to ``timeout``; raise ``TimeoutError`` on expiry
     (ref futures.py:138-165)."""
-    return fut.result(timeout=_as_seconds(timeout))
+    try:
+        return fut.result(timeout=_as_seconds(timeout))
+    except concurrent.futures.TimeoutError:
+        if fut.done():
+            # The future COMPLETED with a TimeoutError of its own (on
+            # 3.11+ the classes are one) — that is the real error, not a
+            # wait expiry; rewriting it would sever the cause chain.
+            raise
+        # On < 3.11, concurrent.futures.TimeoutError is NOT the builtin
+        # TimeoutError this API (and future_timeout) promises — normalize
+        # so callers can catch one class on every supported Python.
+        raise TimeoutError(
+            f"future timed out after {_as_seconds(timeout)}s"
+        ) from None
 
 
 def future_chain(fut: "Future[T]", fn: "Callable[[Future[T]], S]") -> "Future[S]":

@@ -796,6 +796,41 @@ class Manager:
         + observers)."""
         return self._replica_world_size
 
+    # ------------------------------------------------- wire introspection
+    # Pass-through to the comm context (identity-wire defaults when the
+    # context predates the API). The DDP error-feedback arena reads these
+    # through the manager so it needs no direct transport handle: codec
+    # lossiness decides whether residuals exist at all, and the
+    # generation counter — bumped by every comm.configure, i.e. every
+    # membership change — is the signal to RESET them (a residual
+    # describes quantization error already "owed" to a specific cohort;
+    # carrying it into a new quorum would inject stale error).
+
+    def wire_codec_name(self) -> str:
+        fn = getattr(self._comm, "wire_codec_name", None)
+        return fn() if callable(fn) else "none"
+
+    def wire_is_lossy(self) -> bool:
+        fn = getattr(self._comm, "wire_is_lossy", None)
+        return bool(fn()) if callable(fn) else False
+
+    def wire_compensable(self) -> bool:
+        fn = getattr(self._comm, "wire_compensable", None)
+        # Contexts predating the role-aware predicate fall back to codec
+        # lossiness — over-compensating beats silently disabling EF.
+        return bool(fn()) if callable(fn) else self.wire_is_lossy()
+
+    def wire_generation(self) -> int:
+        fn = getattr(self._comm, "wire_generation", None)
+        return int(fn()) if callable(fn) else 0
+
+    def wire_roundtrip(self, src: np.ndarray, out: np.ndarray) -> None:
+        fn = getattr(self._comm, "wire_roundtrip", None)
+        if callable(fn):
+            fn(src, out)
+        else:
+            np.copyto(out, src)
+
     def transport_world_size(self) -> int:
         """Members of the gradient wire for the current quorum (data-plane
         replicas: participants + healing receivers, minus observers).

@@ -122,7 +122,13 @@ def _ring_flash_fwd_impl(q, k, v, axis_name: str, causal: bool,
     from torchft_tpu.ops.flash import flash_attention_with_lse
 
     n = lax.psum(1, axis_name)
-    idx = lax.axis_index(axis_name)
+    # axis_index only when the causal block schedule needs it: a DEAD
+    # axis_index in the non-causal jaxpr survives DCE inside the
+    # custom_vjp call and lowers to a naked PartitionId that the SPMD
+    # partitioner rejects ("PartitionId instruction is not supported for
+    # SPMD partitioning") — jit of the causal=False flash ring failed on
+    # exactly this.
+    idx = lax.axis_index(axis_name) if causal else None
     b, s_local, h, d = q.shape
     eff_scale = scale if scale is not None else 1.0 / (d ** 0.5)
 
@@ -131,7 +137,6 @@ def _ring_flash_fwd_impl(q, k, v, axis_name: str, causal: bool,
 
     def body(t, carry):
         o_acc, lse_acc, k_t, v_t = carry
-        src = (idx - t) % n
 
         def attend(causal_flag: bool):
             return lambda: flash_attention_with_lse(
@@ -140,6 +145,7 @@ def _ring_flash_fwd_impl(q, k, v, axis_name: str, causal: bool,
             )
 
         if causal:
+            src = (idx - t) % n
             o_t, lse_t = lax.cond(
                 src > idx,
                 lambda: (jnp.zeros(q.shape, q.dtype),
@@ -204,7 +210,9 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k,
 
     q, k, v, out, lse = residuals
     n = lax.psum(1, axis_name)
-    idx = lax.axis_index(axis_name)
+    # Same dead-axis_index hazard as the forward: only materialize idx
+    # when the causal schedule uses it.
+    idx = lax.axis_index(axis_name) if causal else None
     b, s_local, h, d = q.shape
     eff_scale = scale if scale is not None else 1.0 / (d ** 0.5)
 
@@ -218,7 +226,6 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k,
 
     def body(t, carry):
         dq_acc, k_t, v_t, dk_t, dv_t = carry
-        src = (idx - t) % n
 
         def pair_bwd(causal_flag: bool):
             return lambda: flash_block_attention_bwd(
@@ -227,6 +234,7 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k,
             )
 
         if causal:
+            src = (idx - t) % n
             dq_t, dk_p, dv_p = lax.cond(
                 src > idx,
                 lambda: (jnp.zeros(q.shape, q.dtype),
