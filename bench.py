@@ -346,6 +346,24 @@ def _bench_chunk_bytes() -> int:
     return int(os.environ.get("BENCH_CHUNK_KB", "1024")) << 10
 
 
+def _bench_bucket_bytes() -> int:
+    """DDP bucket size for the bench's gradient wire. BENCH_BUCKET_KB
+    overrides the library default (32768 = 32MB) — the tiny CPU model's
+    whole grad tree fits one 32MB bucket, so a small setting is how the
+    multi-bucket streamed pipeline (and the t1_pipeline_overlap gauge,
+    which needs >= 2 buckets to mean anything) is exercised at that
+    scale. Bucket layout must match across replicas (identical op
+    sequences per lane); the child reads the same env."""
+    return int(os.environ.get("BENCH_BUCKET_KB", str(32 * 1024))) << 10
+
+
+def _bench_ddp_streamed() -> bool:
+    """BENCH_DDP_STREAMED=0 pins DDP to the PR 2 lock-step submit+drain
+    path — the A/B lever for the streamed-pipeline evidence runs. Any
+    other value (default) runs the streamed per-bucket pipeline."""
+    return os.environ.get("BENCH_DDP_STREAMED", "1") != "0"
+
+
 def _chaos_ratios(t2, t1, t0, n_replicas, backend) -> dict:
     """Chaos efficiency fields with the contended-host qualification.
 
@@ -432,7 +450,7 @@ def _classic_overhead_phase(t0_step_ms=None) -> dict:
         params = {"w": jnp.ones((512, 512)), "b": jnp.zeros((512,))}
         tx = optax.adamw(1e-3)
         opt = OptimizerWrapper(manager, tx)
-        ddp = DistributedDataParallel(manager)
+        ddp = DistributedDataParallel(manager, streamed=_bench_ddp_streamed())
         state = opt.init(params)
 
         @jax.jit
@@ -1131,7 +1149,10 @@ def _child_main() -> None:
         connect_timeout=60.0,
         data_plane=not observer,
     )
-    ddp = DistributedDataParallel(manager)
+    ddp = DistributedDataParallel(
+        manager, bucket_bytes=_bench_bucket_bytes(),
+        streamed=_bench_ddp_streamed(),
+    )
     opt = OptimizerWrapper(
         manager, tx,
         state_fn=lambda: (holder["params"], holder["opt"]),
@@ -1367,7 +1388,10 @@ def _run() -> None:
         quorum_timeout=60.0,
         connect_timeout=60.0,
     )
-    ddp = DistributedDataParallel(manager)
+    ddp = DistributedDataParallel(
+        manager, bucket_bytes=_bench_bucket_bytes(),
+        streamed=_bench_ddp_streamed(),
+    )
     opt = OptimizerWrapper(
         manager, tx,
         state_fn=lambda: (
@@ -1580,12 +1604,42 @@ def _run() -> None:
             for name in (
                 "quorum", "commit_barrier", "allreduce",
                 "comm_submit_wire", "comm_wire_reduce", "comm_reduce_future",
+                "comm_op_wire",
             )
             for stat in ("avg", "p50", "p95", "max")
         )
         if k in _m
     }
     _PARTIAL["t1_overhead_ms"] = t1_overhead
+    # Step-pipeline stage breakdown (per-bucket d2h/ef/wire/h2d wall
+    # times recorded by the DDP wrapper into the manager's sink) and the
+    # overlap gauge: t1_pipeline_overlap = 1 - exposed/total, where
+    # `total` sums every bucket's wire time and `exposed` is the slice
+    # left uncovered after the submit loop ended. ~0 = the wire is fully
+    # serialized against the host work (single bucket); > 0 = wire time
+    # hidden behind pack/EF/unpack of other buckets. BOTH DDP modes
+    # record it (the lock-step path also hides wire behind its pack
+    # loop — its difference is the exposed unpack/EF tail), so the
+    # BENCH_DDP_STREAMED A/B compares like for like. None when no
+    # classic DDP step ran (solo wire).
+    t1_pipeline_ms = {
+        k: round(_m[k], 2)
+        for k in (
+            f"ddp_{stage}_{stat}_ms"
+            for stage in ("d2h", "ef", "wire", "h2d",
+                          "wire_total", "wire_exposed")
+            for stat in ("avg", "p50", "p95", "max")
+        )
+        if k in _m
+    }
+    _PARTIAL["t1_pipeline_ms"] = t1_pipeline_ms
+    _wire_total = _m.get("ddp_wire_total_avg_ms")
+    _wire_exposed = _m.get("ddp_wire_exposed_avg_ms")
+    t1_pipeline_overlap = (
+        round(max(0.0, min(1.0, 1.0 - _wire_exposed / _wire_total)), 4)
+        if _wire_total else None
+    )
+    _PARTIAL["t1_pipeline_overlap"] = t1_pipeline_overlap
     t1_lane_ms = {
         k: round(v, 2)
         for k, v in _m.items()
@@ -1784,6 +1838,9 @@ def _run() -> None:
             ),
             "commit_rate": t1_commit_rate,
             "t1_overhead_ms": t1_overhead,
+            "t1_pipeline_ms": t1_pipeline_ms,
+            "t1_pipeline_overlap": t1_pipeline_overlap,
+            "t1_ddp_streamed": _bench_ddp_streamed(),
             "t1_lane_ms": t1_lane_ms,
             "t1_lane_balance": t1_lane_balance,
             "t1_fused_steps": t1_fused,

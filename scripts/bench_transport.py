@@ -17,6 +17,8 @@ the deployment topology.
     python scripts/bench_transport.py            # CI-sized
     python scripts/bench_transport.py --full     # adds 32MB payloads
     python scripts/bench_transport.py --stripe-sweep   # chunk x lanes x codec
+    python scripts/bench_transport.py --overlap-ab 5   # serial vs streamed
+                                                       # multi-bucket schedule
 
 With chunk striping (PR 2) a single op rides ALL lanes, so channels>1
 changes single-op latency, not just multi-op overlap. `gbps` is the
@@ -63,15 +65,31 @@ ctx = TcpCommContext(
     **spec["extra"],
 )
 ctx.configure(spec["store"], spec["rank"], spec["world"])
-data = np.empty(spec["nbytes"] // 4, dtype=np.float32)
+# buckets > 1 splits the payload into equal per-bucket arrays (the DDP
+# bucket shape); mode picks the submission schedule — "serial" waits each
+# bucket out before submitting the next (the lock-step step loop's wire
+# shape), "streamed" keeps every bucket in flight at once (the streamed
+# step pipeline's wire shape). buckets=1 is the classic single-op cell
+# and both modes coincide.
+buckets = int(spec.get("buckets", 1))
+mode = spec.get("mode", "streamed")
+elems = spec["nbytes"] // 4 // buckets
+datas = [np.empty(elems, dtype=np.float32) for _ in range(buckets)]
 fill = np.float32(spec["rank"] + 1)
 lat = []
 for i in range(spec["warmup"] + spec["iters"]):
     # allreduce reduces IN PLACE (donation contract): refill each
     # iteration outside the timed region, mirroring the DDP arena pack.
-    data.fill(fill)
+    for data in datas:
+        data.fill(fill)
     t0 = time.perf_counter()
-    ctx.allreduce([data]).future().result(timeout=30)
+    if mode == "serial":
+        for data in datas:
+            ctx.allreduce([data]).future().result(timeout=30)
+    else:
+        works = [ctx.allreduce([data]) for data in datas]
+        for w in works:
+            w.future().result(timeout=30)
     if spec["rank"] == 0 and i >= spec["warmup"]:
         lat.append(time.perf_counter() - t0)
 if spec["rank"] == 0:
@@ -103,9 +121,11 @@ def _percentiles(vals):
 
 
 def _bench_config(store, algorithm, world, channels, nbytes, iters, warmup,
-                  tree=None, **extra):
+                  tree=None, buckets=1, mode="streamed", **extra):
     """One (tree, algorithm, world, channels, extra-ctx-kwargs) cell;
-    returns rank-0 latency percentiles + lane balance."""
+    returns rank-0 latency percentiles + lane balance. ``buckets``/
+    ``mode`` select the multi-bucket submission schedule (--overlap-ab);
+    the defaults reproduce the classic single-op cell."""
     _CELL_SEQ[0] += 1
     prefix = f"bt{_CELL_SEQ[0]}"
     procs = []
@@ -116,6 +136,7 @@ def _bench_config(store, algorithm, world, channels, nbytes, iters, warmup,
             "rank": rank, "world": world,
             "algorithm": algorithm, "channels": channels,
             "nbytes": nbytes, "iters": iters, "warmup": warmup,
+            "buckets": buckets, "mode": mode,
             "extra": extra,
         }
         procs.append(subprocess.Popen(
@@ -259,6 +280,65 @@ def _ab_focus(store, payload_mb: int, iters_override, baseline_tree,
     return cells
 
 
+def _overlap_ab(store, payload_mb: int, iters_override, buckets: int,
+                reps: int) -> list:
+    """Same-run interleaved A/B of per-bucket wire overlap: ``serial``
+    submits bucket k+1 only after bucket k's future resolves (the
+    lock-step step loop's wire schedule); ``streamed`` keeps every
+    bucket in flight at once (the streamed step pipeline's schedule).
+    Arms alternate rep-for-rep so host-load drift hits both equally;
+    each config reports every rep plus the median-of-reps avg and the
+    derived ``overlap_gain`` = 1 - streamed/serial (median avg)."""
+    nbytes = payload_mb << 20
+    iters = iters_override or 10
+    runs: dict = {}
+    order = []
+    for rep in range(reps):
+        for algorithm, world in (("star", 2), ("ring", 3)):
+            for mode in ("serial", "streamed"):
+                label = f"{algorithm}_{mode}"
+                res = _bench_config(
+                    store, algorithm, world, 4, nbytes,
+                    iters=iters, warmup=2, buckets=buckets, mode=mode,
+                )
+                if label not in runs:
+                    runs[label] = []
+                    order.append((label, algorithm, world, mode))
+                runs[label].append(res)
+                print(
+                    f"# rep{rep} {label} b{buckets}: "
+                    f"avg {res['avg_ms']:.1f}ms p50 {res['p50_ms']:.1f}ms",
+                    file=sys.stderr,
+                )
+    cells = []
+    medians = {}
+    for label, algorithm, world, mode in order:
+        reps_res = runs[label]
+        avgs = sorted(r["avg_ms"] for r in reps_res)
+        p50s = sorted(r["p50_ms"] for r in reps_res)
+        medians[label] = avgs[len(avgs) // 2]
+        cells.append({
+            "label": label,
+            "algorithm": algorithm, "world": world, "mode": mode,
+            "channels": 4, "buckets": buckets,
+            "payload_bytes": nbytes, "iters": iters, "reps": reps,
+            "median_avg_ms": round(avgs[len(avgs) // 2], 3),
+            "median_p50_ms": round(p50s[len(p50s) // 2], 3),
+            "min_avg_ms": round(avgs[0], 3),
+            "rep_avg_ms": [round(a, 3) for a in avgs],
+        })
+    for algorithm in ("star", "ring"):
+        serial = medians.get(f"{algorithm}_serial")
+        streamed = medians.get(f"{algorithm}_streamed")
+        if serial and streamed:
+            cells.append({
+                "label": f"{algorithm}_overlap_gain",
+                "algorithm": algorithm, "buckets": buckets,
+                "overlap_gain": round(1.0 - streamed / serial, 4),
+            })
+    return cells
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true", help="add 32MB payloads")
@@ -278,13 +358,27 @@ def main() -> None:
         help="with --ab-baseline: run ONLY the acceptance-criterion "
         "cells (PR1 single-lane vs striped), alternated N times",
     )
+    ap.add_argument(
+        "--overlap-ab", type=int, default=0, metavar="N",
+        help="per-bucket overlap A/B: serial (lock-step) vs streamed "
+        "multi-bucket submission, alternated N reps",
+    )
+    ap.add_argument(
+        "--overlap-buckets", type=int, default=4, metavar="B",
+        help="bucket count for --overlap-ab (payload is split B ways)",
+    )
     args = ap.parse_args()
 
     cells = []
     t_start = time.perf_counter()
     store = StoreServer()
     try:
-        if args.ab_repeat and args.ab_baseline:
+        if args.overlap_ab:
+            cells = _overlap_ab(
+                store, args.sweep_payload_mb, args.iters,
+                args.overlap_buckets, args.overlap_ab,
+            )
+        elif args.ab_repeat and args.ab_baseline:
             cells = _ab_focus(
                 store, args.sweep_payload_mb, args.iters,
                 args.ab_baseline, args.ab_repeat,
@@ -323,7 +417,8 @@ def main() -> None:
 
     print(json.dumps({
         "bench": (
-            "transport_stripe_ab" if args.ab_repeat and args.ab_baseline
+            "transport_overlap_ab" if args.overlap_ab
+            else "transport_stripe_ab" if args.ab_repeat and args.ab_baseline
             else "transport_stripe_sweep" if args.stripe_sweep
             else "transport_loopback_allreduce"
         ),

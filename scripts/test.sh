@@ -3,11 +3,20 @@
 # the exact command the reviewer runs, so builder and reviewer can never
 # drift (pipefail + DOTS_PASSED echo included).
 #
-#   scripts/test.sh          # tier-1 gate (non-slow tests, CPU devices)
-#   FULL=1 scripts/test.sh   # native build + entire suite (slow included)
+#   scripts/test.sh              # tier-1 gate (non-slow tests, CPU devices)
+#   FULL=1 scripts/test.sh       # native build + entire suite (slow included)
+#   BENCH_SMOKE=1 scripts/test.sh  # one short bench.py window; asserts the
+#                                  # streamed-pipeline gauges are present and
+#                                  # finite (metric regressions fail loudly
+#                                  # instead of vanishing from the artifact)
 
 set -u
 cd "$(dirname "$0")/.."
+
+if [ "${BENCH_SMOKE:-0}" = "1" ]; then
+    set -ex
+    exec python scripts/bench_smoke.py
+fi
 
 if [ "${FULL:-0}" = "1" ]; then
     set -ex

@@ -69,6 +69,16 @@ class Work:
     def future(self) -> "Future[List[np.ndarray]]":
         return self._fut
 
+    def add_done_callback(self, fn) -> None:
+        """Continuation hook: ``fn(future)`` runs when the op completes —
+        streamed consumers (the DDP per-bucket pipeline) attach one per
+        bucket so unpack/H2D can start the moment that bucket's wire
+        round trip lands, out of order, instead of after a global drain.
+        The callback runs on the completing thread (for TcpCommContext a
+        transport lane): keep it O(enqueue) cheap — heavy per-bucket
+        work belongs on a caller-owned worker (torchft_tpu/ddp.py)."""
+        self._fut.add_done_callback(fn)
+
 
 class CompletedWork(Work):
     """Immediately-successful work (the _DummyWork analog,

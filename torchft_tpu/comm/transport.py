@@ -464,21 +464,40 @@ def _recv_arrays(
 class _OpState:
     """Completion state shared by one striped op's per-lane sub-ops: the
     LAST lane to finish resolves the caller's future with the donated
-    arrays (reduced in place across all lanes' disjoint chunk views)."""
+    arrays (reduced in place across all lanes' disjoint chunk views).
 
-    __slots__ = ("arrays", "fut", "_remaining", "_lock")
+    Continuation contract: callbacks attached to the op future
+    (``Work.add_done_callback``) run inline on that last lane's thread —
+    they must be O(enqueue) cheap, or they stall every later op queued
+    on the lane. The streamed DDP pipeline honors this by enqueueing
+    per-bucket unpack work to its own bounded worker.
+
+    ``t_submit``/``metrics``: the op's end-to-end wire time (submit →
+    last-lane completion) is observed as ``comm_op_wire`` — per-SUB-op
+    ``comm_wire_reduce`` understates a striped op (each lane reports
+    only its share), and overlap accounting needs the op-level number."""
+
+    __slots__ = ("arrays", "fut", "_remaining", "_lock", "metrics",
+                 "t_submit")
 
     def __init__(self, arrays: List[np.ndarray], fut: Future,
-                 n_subops: int) -> None:
+                 n_subops: int, metrics: "Optional[Metrics]" = None) -> None:
         self.arrays = arrays
         self.fut = fut
         self._remaining = n_subops
         self._lock = threading.Lock()
+        self.metrics = metrics
+        self.t_submit = time.perf_counter()
 
     def subop_done(self) -> bool:
         with self._lock:
             self._remaining -= 1
-            return self._remaining == 0
+            done = self._remaining == 0
+        if done and self.metrics is not None:
+            self.metrics.observe(
+                "comm_op_wire", time.perf_counter() - self.t_submit
+            )
+        return done
 
 
 class _PendingOp:
@@ -1597,7 +1616,8 @@ class TcpCommContext(CommContext):
                     per_lane.setdefault(lane_id, []).append(ch)
                 if not per_lane:  # all views empty: nothing to reduce
                     per_lane = {base: []}
-                state = _OpState(prepared, fut, len(per_lane))
+                state = _OpState(prepared, fut, len(per_lane),
+                                 self.metrics)
                 self.metrics.incr("comm_chunks", float(len(chunks)))
                 if len(per_lane) > 1:
                     self.metrics.incr("comm_striped_ops")

@@ -14,41 +14,105 @@ and returns the averaged pytree. Healing replicas contribute zeros and
 receive the average — which is exactly how they end a step bitwise-identical
 to their donor.
 
-Buckets live in a step-persistent staging arena (one flat host array per
-bucket): D2H copies land into it, the transport reads from it and reduces
-into it in place (the comm-layer donation contract), and the result
-leaves are views of it until the H2D copy — no per-step bucket-sized
-allocation, no transport-side payload copies (docs/architecture.md, "Wire
-format and the zero-copy hot path").
+Streamed step pipeline (default; ``streamed=False`` keeps the lock-step
+shape as an A/B lever and bitwise oracle): the reduce path is a per-bucket
+pipeline whose stages run concurrently instead of serializing on the
+caller's thread —
+
+    d2h   bucket k's device→host fetch + pack into its staging slice
+          (caller thread; bucket k+1's D2H is already in flight)
+    ef    error-feedback residual math for bucket k (bounded worker —
+          OFF the submit path, so bucket k+1's pack/submit never stalls
+          behind bucket k's quantizer)
+    wire  the transport round trip (lanes; chunk-striped)
+    h2d   unpack + the ``jnp.array`` copy back to device, per bucket AS
+          ITS WIRE FUTURE COMPLETES (continuation → bounded worker),
+          out of order — not after a global drain
+
+The step future resolves when the last bucket has landed AND every EF
+task has finished, so ``.result()`` still means "arena quiescent,
+residuals final" exactly as in the lock-step model. Per-stage wall times
+land in the Manager's metrics (``ddp_d2h``/``ddp_ef``/``ddp_wire``/
+``ddp_h2d``, one observation per bucket) plus two per-step gauges
+(``ddp_wire_total``: summed per-bucket wire time; ``ddp_wire_exposed``:
+wire time left exposed after the submit loop finished) from which the
+bench derives ``t1_pipeline_overlap`` = 1 − exposed/total.
+
+Buckets live in step-persistent staging ARENAS (one flat host array per
+bucket per arena): D2H copies land into the arena, the transport reads
+from it and reduces into it in place (the comm-layer donation contract),
+and the result leaves are views of it until the H2D copy — no per-step
+bucket-sized allocation, no transport-side payload copies
+(docs/architecture.md, "Step pipeline"). There are ``staging_arenas``
+(default 2) arena GENERATIONS: a second ``average_gradients_async`` may
+pack into a fresh arena while the previous step's buckets are still on
+the wire — cross-step comm/compute overlap — and the corruption guard
+generalizes from "one outstanding" to a hard error only when every arena
+is still in flight. A strictly sequential caller always reuses arena 0,
+so extra generations cost nothing until overlap is actually used.
 
 When the transport wire runs a lossy codec (bf16/int8), an ERROR-FEEDBACK
-arena rides alongside the staging arena: per float bucket, the
+arena rides alongside each staging arena: per float bucket, the
 quantization error of step t's transmitted contribution
 (e_t = g'_t - C(g'_t), computed against the wire's own chunk grid via
 ``manager.wire_roundtrip``) persists in a host buffer and is added back
-into step t+1's gradients before encoding (g'_{t+1} = g_{t+1} + e_t).
-Every rank compensates its own contribution, so the quantization error
-becomes a delayed correction instead of a bias — the standard EF result
-that makes aggressive codecs (int8) converge like full precision, and
-what makes ``compression="int8"`` safe to enable by default for DDP
-gradient lanes. Residuals are RESET whenever ``manager.wire_generation``
-changes (every quorum membership change / transport reconfigure): a
-residual describes error owed to a specific cohort, and replaying it
-into a new quorum would inject stale gradient mass.
+into the NEXT step that uses the same arena before encoding
+(g' = g + e_prev). Every rank compensates its own contribution, so the
+quantization error becomes a delayed correction instead of a bias — the
+standard EF result that makes aggressive codecs (int8) converge like
+full precision. With N arenas the compensation delay is N steps instead
+of one — still unbiased (EF under pipelining), at 1/N the correction
+rate. In streamed mode the quantizer runs on the bounded worker against
+a snapshot of the transmitted bucket (the donated staging buffer is
+reduced in place, so the contribution is unrecoverable after submit);
+ordering is guaranteed by the step future: residuals are final before
+it resolves, hence before the arena can be reacquired. Residuals are
+RESET whenever ``manager.wire_generation`` changes (every quorum
+membership change / transport reconfigure): a residual describes error
+owed to a specific cohort, and replaying it into a new quorum would
+inject stale gradient mass.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Sequence, Tuple
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from torchft_tpu.futures import future_chain
+from torchft_tpu.futures import FutureGroup, future_all, future_chain
+from torchft_tpu.utils.profiling import timed_span
 
 __all__ = ["DistributedDataParallel", "PureDistributedDataParallel"]
 
 _DEFAULT_BUCKET_BYTES = 32 * 1024 * 1024
+
+# Shared bounded workers for the off-critical-path pipeline stages —
+# process-wide pools rather than per-DDP threads so many wrapper
+# instances (tests, multi-model apps) cannot accumulate idle threads.
+# EF quantizer tasks and per-bucket landings (unpack+H2D) get SEPARATE
+# pools: an EF roundtrip over a 32MB bucket is the heaviest task in the
+# pipeline, and on a shared pool two back-to-back EF tasks would queue
+# every completed bucket's landing behind them — re-serializing the
+# pipeline exactly in the lossy-codec configuration it targets. Tasks
+# never block on other tasks (both stages are pure compute), so the
+# bounded pools cannot deadlock.
+_PIPELINE_LOCK = threading.Lock()
+_PIPELINE_EXECUTORS: "Dict[str, ThreadPoolExecutor]" = {}
+
+
+def _pipeline_executor(kind: str) -> ThreadPoolExecutor:
+    with _PIPELINE_LOCK:
+        ex = _PIPELINE_EXECUTORS.get(kind)
+        if ex is None:
+            ex = ThreadPoolExecutor(
+                max_workers=2,
+                thread_name_prefix=f"torchft_tpu_ddp_{kind}",
+            )
+            _PIPELINE_EXECUTORS[kind] = ex
+        return ex
 
 
 def _ef_dtype(dt: np.dtype) -> bool:
@@ -94,11 +158,12 @@ class _BucketPlan:
         return tuple(zip(self.shapes, [d.str for d in self.dtypes]))
 
     def alloc_staging(self) -> List[np.ndarray]:
-        """One flat host array per bucket — the step-persistent staging
-        arena. Reused every step: D2H copies land into it, the transport
-        reads from it AND reduces into it in place (the comm donation
-        contract), and the unpacked result leaves are views of it until
-        the H2D copy. No per-step bucket-sized allocation survives."""
+        """One flat host array per bucket — one step-persistent staging
+        arena generation. Reused every step that acquires it: D2H copies
+        land into it, the transport reads from it AND reduces into it in
+        place (the comm donation contract), and the unpacked result
+        leaves are views of it until the H2D copy. No per-step
+        bucket-sized allocation survives."""
         return [
             np.empty(
                 sum(self.sizes[i] for i in bucket),
@@ -135,15 +200,40 @@ class _BucketPlan:
             return np.ascontiguousarray(bucket_leaves[0]).ravel()
         return np.concatenate([l.ravel() for l in bucket_leaves])
 
+    def unpack_bucket(self, k: int, data: np.ndarray):
+        """Yield ``(leaf_index, view)`` for bucket k's slices of ``data``
+        — THE definition of the bucket byte layout's inverse, shared by
+        the lock-step :meth:`unpack` and the streamed per-bucket landing
+        so the two paths cannot drift."""
+        offset = 0
+        for i in self.buckets[k]:
+            n = self.sizes[i]
+            yield i, data[offset: offset + n].reshape(self.shapes[i])
+            offset += n
+
     def unpack(self, flat_buckets: Sequence[np.ndarray]) -> List[np.ndarray]:
         leaves: List[np.ndarray] = [None] * len(self.shapes)  # type: ignore[list-item]
-        for bucket, data in zip(self.buckets, flat_buckets):
-            offset = 0
-            for i in bucket:
-                n = self.sizes[i]
-                leaves[i] = data[offset: offset + n].reshape(self.shapes[i])
-                offset += n
+        for k, data in enumerate(flat_buckets):
+            for i, view in self.unpack_bucket(k, data):
+                leaves[i] = view
         return leaves
+
+
+class _Arena:
+    """One staging+residual generation: per-bucket staging buffers, the
+    matching error-feedback residuals (+ the EF snapshot scratch the
+    streamed quantizer reads), and the in-flight future of the last
+    average that used this generation — the corruption guard."""
+
+    __slots__ = ("staging", "residuals", "ef_scratch", "ef_generation",
+                 "inflight")
+
+    def __init__(self) -> None:
+        self.staging: "Optional[List[np.ndarray]]" = None
+        self.residuals: "Optional[List[Optional[np.ndarray]]]" = None
+        self.ef_scratch: "Optional[List[Optional[np.ndarray]]]" = None
+        self.ef_generation: "Optional[int]" = None
+        self.inflight: "Optional[Future]" = None
 
 
 class DistributedDataParallel:
@@ -152,24 +242,64 @@ class DistributedDataParallel:
     ``error_feedback``: "auto" (default) enables the per-bucket residual
     compensation exactly when the manager's wire codec is lossy; True
     forces the arena on (still a no-op under an identity codec); False
-    disables it (raw quantization — expect drift under int8)."""
+    disables it (raw quantization — expect drift under int8).
+
+    ``staging_arenas``: arena generations (default 2). A second
+    ``average_gradients_async`` may start while the previous one is still
+    on the wire as long as a free generation exists; all generations in
+    flight is a hard error (the corruption guard). 1 restores the strict
+    one-outstanding PR 2 semantics. Overlapping calls must come from ONE
+    submitter thread, in the same program order on every rank — the
+    transport pairs collectives across ranks by submission order, so
+    racing submitters would mix steps cross-rank (see _acquire_arena).
+
+    ``streamed``: True (default) runs the per-bucket streamed pipeline
+    (see module docstring); False keeps the lock-step submit loop +
+    global drain — the A/B lever and the bitwise oracle the streamed
+    path is tested against."""
 
     def __init__(self, manager, bucket_bytes: int = _DEFAULT_BUCKET_BYTES,
-                 error_feedback: "bool | str" = "auto") -> None:
+                 error_feedback: "bool | str" = "auto",
+                 staging_arenas: int = 2,
+                 streamed: bool = True) -> None:
         if error_feedback not in (True, False, "auto"):
             raise ValueError(
                 f"error_feedback must be True/False/'auto', "
                 f"got {error_feedback!r}"
             )
+        if staging_arenas < 1:
+            raise ValueError("staging_arenas must be >= 1")
         self._manager = manager
         self._bucket_bytes = bucket_bytes
         self._error_feedback = error_feedback
-        self._plan: "_BucketPlan | None" = None
-        self._staging: "List[np.ndarray] | None" = None
-        self._residuals: "List[np.ndarray] | None" = None
-        self._ef_generation: "int | None" = None
-        self._inflight: "Any | None" = None
+        self._streamed = bool(streamed)
+        self._plan: "Optional[_BucketPlan]" = None
+        self._arenas = [_Arena() for _ in range(int(staging_arenas))]
         self._plan_lock = threading.Lock()
+        self._arena_lock = threading.Lock()
+
+    # Introspection/test compat: the primary arena's EF state (a strictly
+    # sequential caller only ever touches arena 0 — see _acquire_arena).
+
+    @property
+    def _residuals(self):
+        return self._arenas[0].residuals
+
+    @property
+    def _ef_generation(self):
+        return self._arenas[0].ef_generation
+
+    def _metrics(self):
+        return getattr(self._manager, "metrics", None)
+
+    def _wire_healthy(self) -> bool:
+        """Gauge gate: the pipeline wire timers are only meaningful when
+        ops actually ride the wire. After a latched transport error every
+        allreduce resolves inline (CompletedWork fallback), and its ~0ms
+        'wire' time would inflate the overlap gauge the bench grades —
+        skip the observation instead (the step never commits anyway)."""
+        errored = getattr(self._manager, "errored", None)
+        return not callable(errored) or errored() is None
 
     def _ef_active(self) -> bool:
         """Error feedback applies when enabled AND this rank's
@@ -214,6 +344,44 @@ class DistributedDataParallel:
                     )
             return self._plan
 
+    def _acquire_arena(self) -> "Tuple[_Arena, Future]":
+        """First-free acquisition, arena 0 preferred: a strictly
+        sequential caller always reuses generation 0 (later generations
+        are never even allocated), while an overlapping caller spills to
+        the next free one. The PR 2 one-outstanding corruption guard
+        generalizes to N: packing into an arena whose previous step is
+        still on the wire would reduce corrupted buffers WITHOUT any
+        error — so the hard error now fires exactly when every
+        generation is in flight.
+
+        Check-and-claim is atomic: the arena is marked busy with an
+        unresolved PLACEHOLDER future under a lock (the real step future
+        does not exist until the submit loop finishes), so a misuse from
+        two threads can never silently claim the same generation. NOTE
+        the lock protects LOCAL buffers only — cross-step overlap must
+        still be driven from ONE submitter thread (submit step t+1 after
+        step t's average_gradients_async returns, before awaiting it):
+        the transport matches collectives across ranks by per-lane
+        submission ORDER, so two threads racing their submit loops would
+        interleave differently on different ranks and reduce step t
+        against step t+1 with no detectable frame mismatch (identical
+        frozen bucket layouts). Single-submitter program order is what
+        keeps the op sequence deterministic across ranks."""
+        with self._arena_lock:
+            for arena in self._arenas:
+                f = arena.inflight
+                if f is None or f.done():
+                    placeholder: Future = Future()
+                    placeholder.set_running_or_notify_cancel()
+                    arena.inflight = placeholder
+                    return arena, placeholder
+            raise RuntimeError(
+                f"average_gradients_async called with all "
+                f"{len(self._arenas)} staging arena generations in "
+                "flight; await a prior result first or raise "
+                "staging_arenas"
+            )
+
     def average_gradients(self, grads: Any) -> Any:
         """Average a grad pytree across replica groups. Blocking; returns a
         pytree of jax arrays with the input structure. On transport error
@@ -225,7 +393,6 @@ class DistributedDataParallel:
 
     def average_gradients_async(self, grads: Any):
         import jax
-        import jax.numpy as jnp
 
         from torchft_tpu.futures import completed_future
 
@@ -256,103 +423,300 @@ class DistributedDataParallel:
         # Plan from shapes/dtypes alone — no host fetch yet.
         plan = self._get_plan(leaves)
 
-        # Pipelined per-bucket issue (the mid-backward comm-hook analog,
-        # ref ddp.py:49-71): block only on bucket k's leaves, land them in
-        # bucket k's slice of the persistent staging arena, submit its
-        # transport op, then move to bucket k+1 — so bucket k rides the
-        # wire (on its own transport lane) while later host copies land.
-        # The transport reduces IN PLACE into the staging buffer (comm
-        # donation contract) and unpack returns views of it, so the only
-        # copies per bucket are the D2H landing and the final H2D — the
-        # arena is safely reusable next step because jnp.array (an
-        # explicit copy) materializes the result before this future
-        # resolves.
-        from torchft_tpu.utils.profiling import host_span
+        arena, placeholder = self._acquire_arena()
+        try:
+            if arena.staging is None:
+                arena.staging = plan.alloc_staging()
+            ef = self._ef_active()
+            if ef:
+                # Residual arena lifecycle: (re)allocate zeroed on first
+                # use and on every transport incarnation change —
+                # membership changed, so the previous step's quantization
+                # error no longer belongs to this cohort's stream
+                # (docs/architecture.md, "Error feedback").
+                gen = self._manager.wire_generation()
+                if arena.residuals is None or gen != arena.ef_generation:
+                    arena.residuals = [
+                        np.zeros_like(s) if _ef_dtype(s.dtype) else None
+                        for s in arena.staging
+                    ]
+                    arena.ef_generation = gen
 
-        # One outstanding average at a time: the staging arena is shared
-        # across calls, so packing a second step while the first is still
-        # on the wire would reduce corrupted buffers WITHOUT any error —
-        # both steps would commit wrong gradients. (Per-bucket pipelining
-        # within one call is unaffected; it uses disjoint bucket slices.)
-        if self._inflight is not None and not self._inflight.done():
-            raise RuntimeError(
-                "average_gradients_async called while the previous call's "
-                "future is unresolved; the staging arena supports one "
-                "outstanding average — await the prior result first"
-            )
-        if self._staging is None:
-            self._staging = plan.alloc_staging()
-        staging = self._staging
-        ef = self._ef_active()
-        if ef:
-            # Residual arena lifecycle: (re)allocate zeroed on first use
-            # and on every transport incarnation change — membership
-            # changed, so step t-1's quantization error no longer belongs
-            # to this cohort's stream (docs/architecture.md, "Error
-            # feedback").
-            gen = self._manager.wire_generation()
-            if self._residuals is None or gen != self._ef_generation:
-                self._residuals = [
-                    np.zeros_like(s) if _ef_dtype(s.dtype) else None
-                    for s in staging
-                ]
-                self._ef_generation = gen
-        works = []
-        for k, bucket in enumerate(plan.buckets):
-            with host_span(f"ddp_pack_bucket{k}"):
-                host_b = [
-                    np.asarray(jax.device_get(leaves[i])) for i in bucket
-                ]
-                packed = plan.pack_bucket_into(bucket, host_b, staging[k])
-                if ef and self._residuals[k] is not None:
-                    res = self._residuals[k]
-                    # g' = g + e_{t-1}; then e_t = g' - C(g') where C is
-                    # the wire's own per-chunk quantizer — computed BEFORE
-                    # submit (the donated buffer is reduced in place, so
-                    # our transmitted contribution is unrecoverable after).
+            # Both paths replace the placeholder with the real inflight
+            # future THEMSELVES, including on a mid-loop failure —
+            # buckets already submitted keep reducing in place into this
+            # arena, so the guard future must outlive them even when the
+            # submit loop raises partway.
+            if self._streamed:
+                return self._average_streamed(
+                    arena, plan, leaves, treedef, ef
+                )
+            return self._average_lockstep(arena, plan, leaves, treedef, ef)
+        except BaseException:
+            if arena.inflight is placeholder:
+                # Failed before anything reached the wire (staging/
+                # residual allocation, plan bug): release the claim —
+                # nothing is touching the arena.
+                arena.inflight = None
+            raise
+
+    # ------------------------------------------------------- pipeline stages
+
+    def _pack_bucket(self, plan: _BucketPlan, k: int,
+                     leaves: List[Any], staging: List[np.ndarray],
+                     metrics) -> np.ndarray:
+        """Stage d2h: block only on bucket k's leaves and land them in
+        bucket k's slice of the staging arena (the mid-backward comm-hook
+        analog, ref ddp.py:49-71) — bucket k rides the wire while later
+        host copies are still landing."""
+        import jax
+
+        bucket = plan.buckets[k]
+        with timed_span(metrics, "ddp_d2h", span=f"ddp_pack_bucket{k}"):
+            host_b = [np.asarray(jax.device_get(leaves[i])) for i in bucket]
+            return plan.pack_bucket_into(bucket, host_b, staging[k])
+
+    def _ef_residual(self, transmitted: np.ndarray, res: np.ndarray,
+                     metrics) -> None:
+        """Stage ef (residual half): e_t = g' - C(g') where C is the
+        wire's own per-chunk quantizer and ``transmitted`` is g' (or a
+        snapshot of it — the donated staging buffer is reduced in place,
+        so the contribution is unrecoverable after submit)."""
+        with timed_span(metrics, "ddp_ef"):
+            self._manager.wire_roundtrip(transmitted, res)  # res = C(g')
+            np.subtract(transmitted, res, out=res)
+            if not np.all(np.isfinite(res)):
+                # A non-finite gradient poisons its wire image (int8
+                # NaN-scale poisoning, bf16 inf-inf) and the step is
+                # discarded by the commit gate — but the residual
+                # persists. Left NaN it would re-inject the spike into
+                # EVERY later step's gradients until a membership change;
+                # drop that error instead (one step of lost compensation).
+                np.nan_to_num(res, copy=False,
+                              nan=0.0, posinf=0.0, neginf=0.0)
+
+    def _land_bucket(self, plan: _BucketPlan, k: int, reduced: np.ndarray,
+                     in_leaves: List[Any], out_leaves: List[Any],
+                     metrics) -> None:
+        """Stage h2d: unpack bucket k's reduced flat array into its
+        leaves and copy them back to device. jnp.array (copy=True), NOT
+        jnp.asarray: on the CPU backend asarray aliases the numpy buffer
+        — these views point into the reusable arena, and an aliased
+        result would be silently overwritten by the arena's NEXT pack."""
+        import jax.numpy as jnp
+
+        with timed_span(metrics, "ddp_h2d", span=f"ddp_unpack_bucket{k}"):
+            for i, view in plan.unpack_bucket(k, reduced):
+                l = in_leaves[i]
+                out_leaves[i] = (
+                    jnp.array(view, dtype=l.dtype)
+                    if hasattr(l, "dtype") else view
+                )
+
+    # ----------------------------------------------------------- code paths
+
+    def _average_streamed(self, arena: _Arena, plan: _BucketPlan,
+                          leaves: List[Any], treedef, ef: bool) -> Future:
+        """Streamed per-bucket pipeline (module docstring): EF off the
+        submit thread, unpack/H2D per bucket as its wire future
+        completes, step future resolves when the last bucket lands and
+        the last EF task finishes."""
+        import jax
+
+        metrics = self._metrics()
+        staging = arena.staging
+        land_pool = _pipeline_executor("land")
+        ef_pool = _pipeline_executor("ef")
+        group = FutureGroup()
+        n_buckets = len(plan.buckets)
+        device_leaves: List[Any] = [None] * len(plan.shapes)
+        submit_t: List[float] = [0.0] * n_buckets
+        wire_done_t: List[float] = [0.0] * n_buckets
+
+        try:
+            for k in range(n_buckets):
+                packed = self._pack_bucket(plan, k, leaves, staging, metrics)
+                if ef and arena.residuals[k] is not None:
+                    res = arena.residuals[k]
+                    # g' = g + e_prev stays inline (one vector add —
+                    # cheap); the quantizer roundtrip moves to the
+                    # worker, reading a SNAPSHOT of g' because the
+                    # donated buffer below is reduced in place the
+                    # moment the wire takes it.
                     np.add(packed, res, out=packed)
-                    self._manager.wire_roundtrip(packed, res)  # res = C(g')
-                    np.subtract(packed, res, out=res)
-                    if not np.all(np.isfinite(res)):
-                        # A non-finite gradient poisons its wire image
-                        # (int8 NaN-scale poisoning, bf16 inf-inf) and the
-                        # step is discarded by the commit gate — but the
-                        # residual persists. Left NaN it would re-inject
-                        # the spike into EVERY later step's gradients
-                        # until a membership change; drop that error
-                        # instead (one step of lost compensation).
-                        np.nan_to_num(res, copy=False,
-                                      nan=0.0, posinf=0.0, neginf=0.0)
-            works.append(self._manager.allreduce_arrays([packed]))
+                    if arena.ef_scratch is None:
+                        arena.ef_scratch = [None] * n_buckets
+                    if arena.ef_scratch[k] is None:
+                        arena.ef_scratch[k] = np.empty_like(packed)
+                    scratch = arena.ef_scratch[k]
+                    np.copyto(scratch, packed)
+                    group.add(
+                        ef_pool.submit(
+                            self._ef_residual, scratch, res, metrics
+                        )
+                    )
+                submit_t[k] = time.perf_counter()
+                work = self._manager.allreduce_arrays([packed])
+                landed: Future = Future()
+                landed.set_running_or_notify_cancel()
+                group.add(landed)
 
-        def _finish(_f) -> Any:
-            reduced = []
-            for w in works:
-                reduced.append(w.future().result()[0])
-            with host_span("ddp_unpack"):
-                out_leaves = plan.unpack(reduced)
-                # jnp.array (copy=True), NOT jnp.asarray: on the CPU
-                # backend asarray aliases the numpy buffer — these leaves
-                # are views of the reusable arena, and an aliased result
-                # would be silently overwritten by the NEXT step's pack.
-                device_leaves = [
-                    jnp.array(a, dtype=l.dtype) if hasattr(l, "dtype") else a
-                    for a, l in zip(out_leaves, leaves)
-                ]
+                def _on_wire(wf: Future, k: int = k,
+                             landed: Future = landed) -> None:
+                    # Lane-thread continuation: timestamp + enqueue only
+                    # (the transport's O(enqueue) contract, _OpState
+                    # docstring).
+                    wire_done_t[k] = time.perf_counter()
+                    if metrics is not None and self._wire_healthy():
+                        metrics.observe(
+                            "ddp_wire", wire_done_t[k] - submit_t[k]
+                        )
+
+                    def _land() -> None:
+                        try:
+                            reduced = wf.result()[0]
+                            self._land_bucket(
+                                plan, k, reduced, leaves, device_leaves,
+                                metrics,
+                            )
+                            landed.set_result(None)
+                        except Exception as e:  # noqa: BLE001
+                            landed.set_exception(e)
+
+                    land_pool.submit(_land)
+
+                work.add_done_callback(_on_wire)
+        except BaseException as e:
+            # Mid-loop failure with earlier buckets already ON THE WIRE
+            # (reducing in place into this arena): seal the group over
+            # the members added so far and store it as the arena's
+            # inflight guard BEFORE re-raising, so a caller that catches
+            # and retries cannot reacquire the arena while lane threads
+            # are still writing into it. The guard fails with a wrapper
+            # RuntimeError, never the original: a BaseException
+            # (KeyboardInterrupt) would slip through the future
+            # machinery's `except Exception` and leave the guard
+            # unresolved forever — every later acquisition would then
+            # see a permanently-in-flight arena.
+            def _fail() -> None:
+                raise RuntimeError(
+                    "average_gradients submit loop failed mid-flight"
+                ) from e
+
+            arena.inflight = group.seal(_fail)
+            raise
+        t_submitted = time.perf_counter()
+
+        def _assemble():
+            if metrics is not None and self._wire_healthy():
+                # Per-step overlap gauges: total wire time across buckets
+                # vs the slice of it left exposed after the submit loop
+                # ended (wire activity during pack/EF/earlier landings is
+                # hidden by construction). The bench turns these into
+                # t1_pipeline_overlap = 1 - exposed/total.
+                total = sum(
+                    wire_done_t[k] - submit_t[k] for k in range(n_buckets)
+                )
+                exposed = max(0.0, max(wire_done_t) - t_submitted)
+                metrics.observe("ddp_wire_total", total)
+                metrics.observe("ddp_wire_exposed", exposed)
             return jax.tree_util.tree_unflatten(treedef, device_leaves)
 
-        from torchft_tpu.futures import future_all
+        fut = group.seal(_assemble)
+        arena.inflight = fut
+        return fut
+
+    def _average_lockstep(self, arena: _Arena, plan: _BucketPlan,
+                          leaves: List[Any], treedef, ef: bool) -> Future:
+        """PR 2 lock-step issue loop, kept as the streamed path's A/B
+        lever and bitwise oracle: pack + inline EF + submit per bucket,
+        then one global completion before any unpack begins. Same math,
+        same buffers, same submission order as the streamed path — only
+        the scheduling differs, which is what the identity tests pin."""
+        import jax
+
+        metrics = self._metrics()
+        staging = arena.staging
+        n_buckets = len(plan.buckets)
+        works = []
+        submit_t: List[float] = [0.0] * n_buckets
+        wire_done_t: List[float] = [0.0] * n_buckets
+        try:
+            for k in range(n_buckets):
+                packed = self._pack_bucket(plan, k, leaves, staging, metrics)
+                if ef and arena.residuals[k] is not None:
+                    res = arena.residuals[k]
+                    np.add(packed, res, out=packed)
+                    self._ef_residual(packed, res, metrics)
+                submit_t[k] = time.perf_counter()
+                work = self._manager.allreduce_arrays([packed])
+                works.append(work)
+                if metrics is not None:
+                    # Same per-bucket wire observability as the streamed
+                    # path (timestamp-only continuation — O(enqueue)),
+                    # so an A/B run measures both arms' wire time rather
+                    # than reporting the lock-step arm as null.
+                    def _mark(wf: Future, k: int = k) -> None:
+                        wire_done_t[k] = time.perf_counter()
+                        if self._wire_healthy():
+                            metrics.observe(
+                                "ddp_wire", wire_done_t[k] - submit_t[k]
+                            )
+
+                    work.add_done_callback(_mark)
+        except BaseException as e:
+            # Same guard-integrity rule as the streamed path: buckets
+            # already submitted keep reducing in place into this arena —
+            # the inflight future must wait them out before the arena
+            # can be reacquired, even though this call is failing. (The
+            # RuntimeError wrap matters: future_chain's `except
+            # Exception` would not transport a raw KeyboardInterrupt,
+            # leaving the guard unresolved forever.)
+            def _fail(_f) -> None:
+                raise RuntimeError(
+                    "average_gradients submit loop failed mid-flight"
+                ) from e
+
+            arena.inflight = future_chain(
+                future_all([w.future() for w in works]), _fail
+            )
+            raise
+        t_submitted = time.perf_counter()
+
+        def _finish(_f) -> Any:
+            # future_all already resolved every bucket future — collect
+            # without blocking (the old submit-order .result() drain),
+            # with per-bucket h2d spans instead of one global ddp_unpack.
+            device_leaves: List[Any] = [None] * len(plan.shapes)
+            for k, w in enumerate(works):
+                reduced = w.future().result()[0]
+                self._land_bucket(
+                    plan, k, reduced, leaves, device_leaves, metrics
+                )
+            if metrics is not None and all(wire_done_t) \
+                    and self._wire_healthy():
+                metrics.observe("ddp_wire_total", sum(
+                    wire_done_t[k] - submit_t[k] for k in range(n_buckets)
+                ))
+                metrics.observe("ddp_wire_exposed", max(
+                    0.0, max(wire_done_t) - t_submitted
+                ))
+            return jax.tree_util.tree_unflatten(treedef, device_leaves)
 
         fut = future_chain(
             future_all([w.future() for w in works]), _finish
         )
-        self._inflight = fut
+        arena.inflight = fut
         return fut
 
 
 class PureDistributedDataParallel:
     """Per-leaf (unbucketed) variant — simpler, more round trips
-    (ref ddp.py:75-97)."""
+    (ref ddp.py:75-97). Shares ``DistributedDataParallel``'s safety
+    contract: the quorum gates the reduce (a failed quorum LATCHES so
+    should_commit votes False — returning unreduced grads without the
+    latch would let a quorumless step commit), and a solo wire skips the
+    device→host fetch and the transport round trip entirely."""
 
     def __init__(self, manager) -> None:
         self._manager = manager
@@ -360,6 +724,15 @@ class PureDistributedDataParallel:
     def average_gradients(self, grads: Any) -> Any:
         import jax
         import jax.numpy as jnp
+
+        try:
+            self._manager.wait_quorum()
+        except Exception as e:  # noqa: BLE001 — parity with
+            # DistributedDataParallel: latch, never raise mid-backward
+            self._manager.report_error(e)
+            return grads
+        if self._manager.is_solo_wire():
+            return grads
 
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         host = [np.asarray(jax.device_get(l)) for l in leaves]

@@ -30,6 +30,7 @@ __all__ = [
     "future_wait",
     "future_chain",
     "future_all",
+    "FutureGroup",
     "completed_future",
     "failed_future",
     "TimerHandle",
@@ -201,6 +202,76 @@ def future_all(futs: "list[Future]") -> "Future[list[Future]]":
     for f in futs:
         f.add_done_callback(_done)
     return out
+
+
+class FutureGroup:
+    """Dynamic completion barrier for streamed fan-out pipelines.
+
+    ``future_all`` needs the whole future list up front; a streamed
+    producer (DDP's per-bucket pipeline) creates members incrementally —
+    a wire future per bucket, a worker future per unpack/error-feedback
+    task — while earlier members are already completing on other
+    threads. ``add()`` registers members as they are born, ``seal(fn)``
+    arms the group and returns a future that resolves to ``fn()`` once
+    every member has completed (out of order, on whichever thread
+    finishes last — keep ``fn`` cheap).
+
+    Error semantics: the first member (or ``fn``) exception fails the
+    group future, but only AFTER every member has settled — so resources
+    the group guards (e.g. a staging arena generation) are guaranteed
+    quiescent by the time the group future is done, success or not.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._sealed = False
+        self._fn: "Optional[Callable[[], object]]" = None
+        self._error: Optional[BaseException] = None
+        self._out: Future = Future()
+        self._out.set_running_or_notify_cancel()
+
+    def add(self, fut: Future) -> None:
+        """Register a member. Must happen before :meth:`seal`; members may
+        already be completed (their callback fires inline)."""
+        with self._lock:
+            if self._sealed:
+                raise RuntimeError("FutureGroup.add after seal")
+            self._pending += 1
+        fut.add_done_callback(self._member_done)
+
+    def _member_done(self, f: Future) -> None:
+        exc = f.exception()
+        with self._lock:
+            if exc is not None and self._error is None:
+                self._error = exc
+            self._pending -= 1
+            finish = self._sealed and self._pending == 0
+        if finish:
+            self._resolve()
+
+    def seal(self, fn: "Callable[[], S]") -> "Future[S]":
+        """Arm the group: no more members may be added; the returned
+        future resolves to ``fn()`` once every member has completed (or
+        fails with the first member error)."""
+        with self._lock:
+            if self._sealed:
+                raise RuntimeError("FutureGroup sealed twice")
+            self._sealed = True
+            self._fn = fn
+            finish = self._pending == 0
+        if finish:
+            self._resolve()
+        return self._out
+
+    def _resolve(self) -> None:
+        if self._error is not None:
+            _try_set_exception(self._out, self._error)  # type: ignore[arg-type]
+            return
+        try:
+            self._out.set_result(self._fn())  # type: ignore[misc]
+        except Exception as e:  # noqa: BLE001
+            _try_set_exception(self._out, e)
 
 
 def completed_future(value: T) -> "Future[T]":

@@ -232,6 +232,17 @@ class Manager:
         self._participating_world_size: int = 0
         self._replica_world_size: int = 0
         self._did_heal = False
+        # One metrics sink for the whole step pipeline: the Manager's own
+        # timers (quorum / commit_barrier / allreduce), the transport's
+        # per-lane and per-op phase timers (comm_submit_wire /
+        # comm_wire_reduce / comm_reduce_future / comm_op_wire, shared in
+        # via set_metrics below), and the DDP wrapper's per-bucket stage
+        # timers (ddp_d2h / ddp_ef / ddp_wire / ddp_h2d plus the
+        # ddp_wire_total / ddp_wire_exposed overlap gauges — the DDP
+        # layer reads this sink through ``manager.metrics``). One
+        # snapshot therefore tells the whole story of where a step's
+        # wall time went, and one reset_timings() bounds a measurement
+        # window for every layer at once (bench.py relies on this).
         self.metrics = Metrics()
         # Share our metrics sink with the transport so its per-lane phase
         # timers (comm_submit_wire / comm_wire_reduce / comm_reduce_future)

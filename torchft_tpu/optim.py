@@ -161,8 +161,25 @@ class OptimizerWrapper:
         with a fully donated update program (no transient second
         params+opt footprint — the 1b multi-peer configuration), paying
         the exposed barrier RPC instead; see __init__.
+
+        ``grads`` may also be the FUTURE returned by
+        ``DistributedDataParallel.average_gradients_async`` — it is
+        resolved here, right before the commit prologue (which drains
+        the same transport work anyway). That lets a training loop
+        submit the average, do more host work (next-batch prefetch,
+        logging) while the buckets ride the wire, and hand the
+        unresolved future straight to ``step()`` — the cross-step
+        comm/compute overlap the DDP staging-arena generations exist
+        for.
         """
         self.classic_steps += 1
+        from concurrent.futures import Future as _Future
+
+        if isinstance(grads, _Future):
+            # every average_gradients_async path returns exactly a
+            # concurrent.futures.Future — an isinstance check can't
+            # misfire on a user pytree that happens to expose .result()
+            grads = grads.result()
         if self._donate_update:
             return self._step_donated(params, opt_state, grads)
         with self.metrics.timed("prologue"):

@@ -18,10 +18,11 @@ Profiling is strictly zero-cost when TORCHFT_TPU_PROFILE_DIR is unset:
 from __future__ import annotations
 
 import os
+import time
 from contextlib import contextmanager
 from typing import Optional
 
-__all__ = ["StepProfiler", "trace", "host_span"]
+__all__ = ["StepProfiler", "trace", "host_span", "timed_span"]
 
 
 @contextmanager
@@ -54,6 +55,24 @@ def host_span(name: str):
         return
     with annotation:
         yield
+
+
+@contextmanager
+def timed_span(metrics, name: str, span: Optional[str] = None):
+    """``host_span`` + ``Metrics.observe`` in one: annotate the profiler
+    timeline (under ``span``, or ``name`` when omitted) AND record the
+    block's wall duration into ``metrics`` under ``name``. The streamed
+    DDP pipeline uses this for its per-bucket stage timers (``ddp_d2h``
+    / ``ddp_ef`` / ``ddp_wire`` / ``ddp_h2d``), so one context manager
+    keeps the trace view and the metrics view of a stage in lockstep.
+    ``metrics=None`` degrades to a plain ``host_span``."""
+    start = time.perf_counter()
+    try:
+        with host_span(span or name):
+            yield
+    finally:
+        if metrics is not None:
+            metrics.observe(name, time.perf_counter() - start)
 
 
 class StepProfiler:
