@@ -1681,6 +1681,7 @@ def _run() -> None:
     chaos_fused = chaos_classic = None
     chaos_participants_end = chaos_world_end = None
     chaos_respawn = None
+    chaos_heal_ms = None
     chaos_seconds = float(os.environ.get("BENCH_CHAOS_SECONDS", "60"))
     if chaos:
         # Pre-warm the replacement replica OUTSIDE the measured window (a
@@ -1755,7 +1756,19 @@ def _run() -> None:
                     else:
                         children[0] = spawn(1)
                     respawned = True
+                    heal_assigned_at = time.perf_counter()
                 loss = ft_step()
+                if (respawned and chaos_heal_ms is None
+                        and manager.num_participants() >= n_replicas):
+                    # recovery tail attribution: wall-time from the heal
+                    # assignment (replacement promoted) to healed-state
+                    # ready (the healed replica counted as a cohort
+                    # participant again) — the denominator tail that
+                    # bounds chaos_efficiency at 1 kill/min
+                    chaos_heal_ms = round(
+                        (time.perf_counter() - heal_assigned_at)
+                        * 1000.0, 1,
+                    )
             _sync(loss)
             t2_elapsed = time.perf_counter() - t_start
         except Exception as e:  # noqa: BLE001 — chaos must not eat T1
@@ -1769,6 +1782,7 @@ def _run() -> None:
             )
             chaos = False
             chaos_respawn = None
+            chaos_heal_ms = None
         else:
             chaos_committed = committed - committed_before
             chaos_attempted = attempted - attempted_before
@@ -1873,6 +1887,7 @@ def _run() -> None:
             "chaos_replica_world_end": chaos_world_end,
             "chaos_participants_end": chaos_participants_end,
             "chaos_respawn": chaos_respawn,
+            "chaos_heal_ms": chaos_heal_ms,
             "chaos_fused_steps": chaos_fused,
             "chaos_classic_steps": chaos_classic,
             "localsgd": sync_results["localsgd"],

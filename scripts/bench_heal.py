@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Heal-plane A/B: legacy pickle path vs zero-copy streaming path.
+
+Measures end-to-end heal wall-time — ``send_checkpoint`` (staging) through
+``recv_checkpoint`` (healed host state ready) — on a loopback donor/healer
+pair, with the two arms REP-INTERLEAVED (the PR 2/3 evidence protocol:
+alternating arms inside one process run means OS/load drift hits both
+arms equally, so a delta is attributable to the code path, not the
+minute it ran in).
+
+Arms:
+  legacy     eager full-tree staging inside send_checkpoint + one
+             full-stream pytree pickle over one connection
+             (the pre-ISSUE-4 default path)
+  streaming  lazy per-leaf staging (manifest metadata-only, background
+             stager, request priority bump) + raw-bytes leaf fetches
+             readinto preallocated arrays over N keep-alive connections
+
+Both arms are verified BITWISE identical to the source state before any
+timing is trusted. Usage:
+
+  JAX_PLATFORMS=cpu python scripts/bench_heal.py --mb 64 --reps 4 \
+      --chunks 4 --out docs/evidence/bench_heal_rXX.json
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _build_state(total_mb: int):
+    """>= total_mb of fp32 leaves (16 equal slabs — a realistic leaf
+    count, so lazy staging has a pipeline to overlap) plus a small bf16
+    leaf to keep the ml_dtypes path honest."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    n_leaves = 16
+    per_leaf = max(1, total_mb * (1 << 20) // n_leaves // 4)
+    rng = np.random.default_rng(0)
+    state = {
+        "params": {
+            f"w{i:02d}": jnp.asarray(
+                rng.standard_normal(per_leaf, dtype=np.float32)
+            )
+            for i in range(n_leaves)
+        },
+        "scale": jnp.asarray(
+            rng.standard_normal(4096, dtype=np.float32)
+        ).astype(jnp.bfloat16),
+        "torchft": {"step": 0, "batches_committed": 0},
+    }
+    nbytes = n_leaves * per_leaf * 4 + 4096 * 2
+    return state, nbytes
+
+
+def _bitwise_equal(a, b) -> bool:
+    import jax
+    import numpy as np
+
+    fa, _ = jax.tree_util.tree_flatten(a)
+    fb, _ = jax.tree_util.tree_flatten(b)
+    if len(fa) != len(fb):
+        return False
+    for x, y in zip(fa, fb):
+        if hasattr(x, "dtype") or hasattr(y, "dtype"):
+            xa, ya = np.asarray(x), np.asarray(y)
+            if (xa.dtype != ya.dtype or xa.shape != ya.shape
+                    or xa.tobytes() != ya.tobytes()):
+                return False
+        elif x != y:
+            return False
+    return True
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=64,
+                    help="state size in MB (acceptance floor: 64)")
+    ap.add_argument("--reps", type=int, default=4,
+                    help="interleaved reps per arm")
+    ap.add_argument("--chunks", type=int, default=4,
+                    help="streaming arm parallel connections")
+    ap.add_argument("--warmup", type=int, default=1,
+                    help="untimed warmup reps per arm")
+    ap.add_argument("--out", default=None, help="write JSON here too")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from torchft_tpu.checkpointing import CheckpointServer
+
+    state, nbytes = _build_state(args.mb)
+
+    arms = {
+        "legacy": dict(lazy_stage=False, num_chunks=0),
+        "streaming": dict(lazy_stage=True, num_chunks=args.chunks),
+    }
+    samples = {name: [] for name in arms}
+    healed = {}
+
+    donors = {
+        name: CheckpointServer(timeout=120.0, lazy_stage=cfg["lazy_stage"])
+        for name, cfg in arms.items()
+    }
+    healers = {
+        name: CheckpointServer(timeout=120.0, num_chunks=cfg["num_chunks"])
+        for name, cfg in arms.items()
+    }
+    try:
+        import gc
+
+        step = 0
+        for rep in range(args.warmup + args.reps):
+            timed = rep >= args.warmup
+            for name in arms:  # interleaved: L S L S ...
+                step += 1
+                donor, healer = donors[name], healers[name]
+                got = None
+                gc.collect()  # prior reps' 64MB of garbage must not
+                # collect inside either arm's timed window
+                t0 = time.perf_counter()
+                donor.send_checkpoint([], step, state, 120.0)
+                got = healer.recv_checkpoint(
+                    0, donor.metadata(), step, 120.0
+                )
+                wall = time.perf_counter() - t0
+                donor.disallow_checkpoint()
+                if timed:
+                    samples[name].append(wall * 1000.0)
+                if name not in healed:
+                    healed[name] = got
+                sys.stderr.write(
+                    f"bench_heal rep {rep}{'' if timed else ' (warmup)'}"
+                    f" {name}: {wall * 1000.0:.1f}ms\n"
+                )
+    finally:
+        for s in list(donors.values()) + list(healers.values()):
+            s.shutdown()
+
+    bitwise_ok = all(_bitwise_equal(h, state) for h in healed.values())
+    p50 = {n: statistics.median(v) for n, v in samples.items()}
+    improvement = (
+        (p50["legacy"] - p50["streaming"]) / p50["legacy"] * 100.0
+        if p50["legacy"] > 0 else None
+    )
+    payload = {
+        "metric": "bench_heal",
+        "state_mb": round(nbytes / (1 << 20), 1),
+        "reps": args.reps,
+        "chunks": args.chunks,
+        "interleaved": True,
+        "legacy_ms": [round(v, 1) for v in samples["legacy"]],
+        "streaming_ms": [round(v, 1) for v in samples["streaming"]],
+        "legacy_p50_ms": round(p50["legacy"], 1),
+        "streaming_p50_ms": round(p50["streaming"], 1),
+        "improvement_pct": (
+            round(improvement, 1) if improvement is not None else None
+        ),
+        "bitwise_identical": bitwise_ok,
+    }
+    print(json.dumps(payload))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1)
+    if not bitwise_ok:
+        sys.stderr.write("bench_heal: BITWISE MISMATCH between arms\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,8 +23,61 @@ import subprocess
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)  # run as a script: the repo root is not on
+# sys.path (heal_smoke imports torchft_tpu in-process)
 
 _STAGES = ("d2h", "wire", "h2d")  # ef only runs under a lossy codec
+
+
+def heal_smoke() -> "list[str]":
+    """One tiny in-process heal round; returns failure strings if the
+    heal_* metric surface is missing or non-finite. Runs the REAL
+    streaming plane: lazy-staged donor, raw-bytes chunked healer."""
+    import math
+
+    import numpy as np
+
+    import jax.numpy as jnp
+    from torchft_tpu.checkpointing import CheckpointServer
+    from torchft_tpu.utils.metrics import Metrics
+
+    failures = []
+    state = {
+        "w": jnp.asarray(
+            np.random.default_rng(0).standard_normal(1 << 16),
+            dtype=jnp.float32,
+        ),
+        "torchft": {"step": 1},
+    }
+    donor = CheckpointServer(timeout=30.0)
+    healer = CheckpointServer(timeout=30.0, num_chunks=2)
+    dm, hm = Metrics(), Metrics()
+    donor.set_metrics(dm)
+    healer.set_metrics(hm)
+    try:
+        donor.send_checkpoint([], 1, state, 30.0)
+        got = healer.recv_checkpoint(0, donor.metadata(), 1, 30.0)
+        donor.disallow_checkpoint()
+        if np.asarray(got["w"]).tobytes() != np.asarray(
+            state["w"]
+        ).tobytes():
+            failures.append("heal smoke: healed state not bitwise")
+        d, h = dm.snapshot(), hm.snapshot()
+        for src, key in (
+            (d, "heal_stage_avg_ms"),
+            (h, "heal_wire_avg_ms"),
+            (h, "heal_wall_ms"),
+            (h, "heal_bytes_per_s"),
+        ):
+            v = src.get(key)
+            if v is None or not math.isfinite(float(v)) or v < 0:
+                failures.append(
+                    f"heal smoke: gauge {key!r} missing/non-finite: {v!r}"
+                )
+    finally:
+        donor.shutdown()
+        healer.shutdown()
+    return failures
 
 
 def main() -> int:
@@ -67,7 +120,7 @@ def main() -> int:
               file=sys.stderr)
         return 1
 
-    failures = []
+    failures = heal_smoke()
     for key in ("t1_pipeline_overlap", "t1_pipeline_ms", "t1_ddp_streamed",
                 "t1_overhead_ms"):
         if key not in payload:
@@ -102,7 +155,8 @@ def main() -> int:
         "bench smoke OK: "
         f"overlap={payload['t1_pipeline_overlap']} "
         f"classic_steps={classic} "
-        f"stages={sorted(payload['t1_pipeline_ms'])}"
+        f"stages={sorted(payload['t1_pipeline_ms'])} "
+        "heal_gauges=ok"
     )
     return 0
 

@@ -8,36 +8,71 @@ mutate state mid-send — `send_checkpoint` stages the state and opens the
 gate for a specific step; `should_commit` closes it again
 (ref manager.py:591).
 
-The payload is a streamed pytree pickle (device→host via
-utils/serialization); on TPU the device_get happens once at staging time,
-and a donor can serve many healing peers from the same staged host copy.
+Zero-copy streaming pipeline (the heal plane's analog of the gradient
+transport's PR 1-2 rebuild; byte primitives shared via comm/wire.py):
 
-Trust model: like the reference's torch.load-based transport
-(/root/reference/torchft/checkpointing.py), the full-stream, manifest, and
-leaves endpoints deserialize PICKLE from whatever address quorum metadata
-names — run it on a trusted cluster network only. The per-leaf shard
-endpoint (`/checkpoint/{step}/leaf/{i}`) is raw bytes + dtype/shape
-headers, with no code-execution surface; the sharded heal path
-(`recv_checkpoint_sharded`) uses pickle only for the manifest.
+- Donor: staging is LAZY-PER-LEAF. ``send_checkpoint`` builds the
+  manifest from metadata only (shapes/dtypes/shard indices — no D2H) and
+  opens the gate immediately; a background stager drains leaves in order
+  while an HTTP handler that needs leaf *i* NOW claims and stages it
+  inline (``futures.StealableTask`` — the priority bump is the requester
+  stealing the work onto its own thread). The healer's first fetch
+  therefore streams while later leaves are still leaving the device.
+  ``disallow_checkpoint`` finishes residual staging synchronously before
+  dropping the gate, so the trainer can never donate a device buffer a
+  pending stage still needs.
+- Donor serve path: leaf/slice tensor bytes go out as chunked writes of
+  a ``memoryview`` over the staged array (uint8 reinterpret — no
+  ``tobytes`` copy, no pickle for tensor payloads, no full-body
+  materialization). ``serve_copy_stats`` counts the rare fallbacks.
+- Healer: ``fetch_leaf`` bounds reads to the advertised Content-Length
+  (cross-checked against dtype/shape) and ``readinto``s straight into a
+  preallocated array; large regions stripe across MULTIPLE donors and
+  parallel keep-alive connections on a deterministic grid whose exact
+  cover is verified geometrically; per-leaf H2D overlaps with in-flight
+  network receives on a bounded worker.
+- Heal stays BITWISE by default (trajectory oracles depend on it). The
+  opt-in ``heal_wire_dtype="bf16"`` lever downcasts float leaves on the
+  wire only (same astype roundtrip as the gradient transport's bf16
+  codec) for bandwidth-starved links.
+
+Trust model: the legacy full-stream endpoint still deserializes PICKLE
+from whatever address quorum metadata names — run on a trusted cluster
+network only. The DEFAULT healer paths (chunked, sharded) use pickle
+ONLY for the manifest and non-tensor object leaves; tensor data rides
+raw bytes + dtype/shape headers with no code-execution surface.
 """
 
 from __future__ import annotations
 
+import http.client
+import io
 import logging
 import pickle
 import socket
 import threading
+import time
+import urllib.error
 import urllib.request
 from abc import ABC, abstractmethod
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
 from functools import cached_property
 from datetime import timedelta
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Generic, List, Optional, Sequence, TypeVar
+from typing import Any, Dict, Generic, List, Optional, Sequence, TypeVar
 
 import numpy as np
 
+from torchft_tpu.comm.wire import (
+    as_bytes_view,
+    bf16_wire_dtype,
+    readinto_exact,
+    split_stripes,
+    tensor_wire_view,
+)
+from torchft_tpu.futures import FutureGroup, StealableTask, future_chain
+from torchft_tpu.utils.profiling import throughput_span, timed_span
 from torchft_tpu.utils.serialization import pytree_from_stream, pytree_to_stream
 
 logger = logging.getLogger(__name__)
@@ -51,7 +86,80 @@ __all__ = [
     "fetch_leaf",
     "format_slice_spec",
     "recv_checkpoint_sharded",
+    "serve_copy_stats",
 ]
+
+# Chunk size for streaming a staged leaf's byte view into the socket:
+# large enough that syscall count is negligible, small enough that a
+# dying healer is detected within a chunk.
+_SEND_CHUNK = 1 << 20
+
+# Wire-downcast applies to the same dtypes the gradient codecs compress.
+_WIRE_COMPRESSIBLE = (np.dtype(np.float32), np.dtype(np.float64))
+
+_WIRE_DTYPES = {"bf16": bf16_wire_dtype}
+
+
+# ------------------------------------------------------------- copy counting
+# Test hook (ISSUE 4 acceptance): the donor must perform ZERO full-array
+# copies when serving a C-contiguous non-ml_dtypes leaf. tensor_wire_view
+# reports its copies; the handler accumulates them here.
+
+_copy_stats_lock = threading.Lock()
+_copy_stats = {"zero_copy_serves": 0, "full_array_copies": 0}
+
+
+def serve_copy_stats(reset: bool = False) -> Dict[str, int]:
+    """Snapshot (optionally reset) the donor serve-path copy counters."""
+    with _copy_stats_lock:
+        out = dict(_copy_stats)
+        if reset:
+            for k in _copy_stats:
+                _copy_stats[k] = 0
+    return out
+
+
+def _count_serve(copies: int) -> None:
+    with _copy_stats_lock:
+        if copies == 0:
+            _copy_stats["zero_copy_serves"] += 1
+        else:
+            _copy_stats["full_array_copies"] += copies
+
+
+def _wire_encode(arr: np.ndarray, wire_dtype: "Optional[np.dtype]"):
+    """One tensor's wire bytes: ``(byte view, wire dtype or None)``.
+    The single implementation behind BOTH the /leaf and /rawleaves
+    serve paths — the opt-in downcast inherently allocates (and is not
+    counted as a serve-path copy); the default path is the counted
+    zero-copy view."""
+    if wire_dtype is not None and arr.dtype in _WIRE_COMPRESSIBLE:
+        view, _ = tensor_wire_view(arr.astype(wire_dtype))
+        return view, wire_dtype
+    view, copies = tensor_wire_view(arr)
+    _count_serve(copies)
+    return view, None
+
+
+# ------------------------------------------------------- bounded worker pools
+# Process-wide bounded pools (the PR 3 DDP pattern): staging D2H on the
+# donor and H2D assembly on the healer each get a small dedicated pool so
+# many server instances (tests, multi-model apps) cannot accumulate
+# threads, and a heavy H2D can never queue behind another heal's staging.
+
+_POOL_LOCK = threading.Lock()
+_POOLS: "Dict[str, ThreadPoolExecutor]" = {}
+
+
+def _heal_executor(kind: str) -> ThreadPoolExecutor:
+    with _POOL_LOCK:
+        ex = _POOLS.get(kind)
+        if ex is None:
+            ex = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix=f"torchft_tpu_heal_{kind}"
+            )
+            _POOLS[kind] = ex
+        return ex
 
 
 class _ShardedLeaf:
@@ -121,20 +229,54 @@ class _ShardedLeaf:
         return out
 
 
-def _materialize_leaf(leaf: Any) -> Any:
-    return leaf.read() if isinstance(leaf, _ShardedLeaf) else leaf
-
-
 @dataclass(frozen=True)
 class _Staged:
-    """An immutable host copy of one staged checkpoint, pre-flattened so
-    leaf/manifest requests need no per-request tree work. jax.Array
-    leaves are held shard-wise (_ShardedLeaf)."""
+    """One staged checkpoint: per-leaf StealableTask slots (resolving to
+    the staged host object — np.ndarray, _ShardedLeaf, or a non-tensor
+    object), a metadata-only manifest, and an ``all_staged`` future that
+    resolves once every slot has. Immutable host copies are born as the
+    slots run; the bundle itself is safe to stream from outside the
+    serving gate."""
 
     step: int
-    leaves: List[Any]
+    slots: List[StealableTask]
+    entries: List[dict]
     manifest_bytes: bytes
     treedef: Any = field(repr=False, default=None)
+    all_staged: "Future" = field(repr=False, default=None)  # type: ignore[assignment]
+
+    def leaf(self, i: int, timeout: "Optional[float]" = None) -> Any:
+        """Staged host object for leaf ``i`` — claims and stages it
+        INLINE when the background stager has not reached it yet (the
+        request-priority bump)."""
+        return self.slots[i].result(timeout)
+
+    @property
+    def num_leaves(self) -> int:
+        return len(self.slots)
+
+    @property
+    def leaves(self) -> List[Any]:
+        """Staged host objects, staging any slot that has not run yet
+        (tests / introspection; request paths use :meth:`leaf`)."""
+        return [s.result() for s in self.slots]
+
+    def finish_staging(self, timeout: "Optional[float]" = None) -> None:
+        """Drain every slot on the calling thread (claimed ones are
+        joined, each waited up to ``timeout``). Called by
+        ``disallow_checkpoint`` so a stage task does not normally
+        outlive the gate into territory where the trainer donates
+        device buffers. Staging errors — including a join timeout, the
+        escape hatch that keeps ``should_commit`` bounded — are logged,
+        not raised: if a straggler stage later touches a donated array,
+        jax raises (deleted-buffer access), the slot's future fails,
+        and the healer gets a retryable 503 — never silently corrupt
+        bytes."""
+        for slot in self.slots:
+            try:
+                slot.result(timeout)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("checkpoint leaf staging failed: %s", e)
 
     @cached_property
     def state(self) -> Any:
@@ -145,67 +287,134 @@ class _Staged:
         import jax
 
         return jax.tree_util.tree_unflatten(
-            self.treedef, [_materialize_leaf(l) for l in self.leaves]
+            self.treedef,
+            [_materialize_leaf(s.result()) for s in self.slots],
         )
+
+
+def _materialize_leaf(leaf: Any) -> Any:
+    return leaf.read() if isinstance(leaf, _ShardedLeaf) else leaf
+
+
+def _entry_wire_nbytes(entry: dict,
+                       wire_dtype: "Optional[np.dtype]") -> int:
+    """Wire bytes of one manifest ndarray entry — from METADATA only, so
+    both sides can size a raw multi-leaf stream before any staging."""
+    dtype = _dtype_from_str(entry["dtype"])
+    if wire_dtype is not None and dtype in _WIRE_COMPRESSIBLE:
+        count = int(np.prod(entry["shape"], dtype=np.int64))
+        return count * wire_dtype.itemsize
+    return int(entry["nbytes"])
 
 
 def _build_staged(step: int, state: Any,
                   peers: "Optional[List[str]]" = None,
-                  shard_filter: "Optional[Any]" = None) -> _Staged:
-    """``peers``: other hosts' checkpoint server addresses for this replica
+                  shard_filter: "Optional[Any]" = None,
+                  lazy: bool = False,
+                  metrics: "Optional[Any]" = None,
+                  stage_hook: "Optional[Any]" = None) -> _Staged:
+    """Stage ``state`` for serving.
+
+    The manifest (paths, dtypes, shapes, shard-piece bounds) is built
+    from METADATA ONLY — ``shard.index`` and array shapes need no device
+    transfer — so this returns without a single D2H when ``lazy=True``.
+    Mutation safety varies by leaf kind: jax.Arrays are immutable, so
+    holding the reference and copying later is sound (the donation
+    hazard is handled by ``disallow_checkpoint`` draining slots before
+    the gate closes); np.ndarray leaves are mutable host state and are
+    snapshot EAGERLY; other objects are held by reference exactly as the
+    eager path always did.
+
+    ``peers``: other hosts' checkpoint server addresses for this replica
     group, advertised in the manifest so a healer whose shards span donor
     hosts can fan out. ``shard_filter(path, bounds) -> bool`` drops pieces
     at staging time — the single-process simulation of a real multi-host
-    donor, where ``addressable_shards`` only ever yields the local ones."""
+    donor, where ``addressable_shards`` only ever yields the local ones.
+    """
     import jax
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(state)
-    leaves: List[Any] = []
+    slots: List[StealableTask] = []
     entries = []
-    for keypath, leaf in flat:
+    group = FutureGroup()
+    for i, (keypath, leaf) in enumerate(flat):
         path = jax.tree_util.keystr(keypath)
         if isinstance(leaf, jax.Array):
-            leaf = _ShardedLeaf(leaf)  # per-shard D2H, no assembly
+            shape = tuple(leaf.shape)
+            dtype = np.dtype(leaf.dtype)
+            piece_bounds = sorted({
+                _normalize_index(sh.index, shape)
+                for sh in leaf.addressable_shards
+            })
             if shard_filter is not None:
-                leaf.pieces = {
-                    b: arr for b, arr in leaf.pieces.items()
-                    if shard_filter(path, b)
-                }
-        elif isinstance(leaf, np.ndarray):
-            leaf = np.array(leaf, copy=True)  # detach from live training
-        leaves.append(leaf)
-        if isinstance(leaf, (np.ndarray, _ShardedLeaf)):
-            pieces = (
-                sorted(leaf.pieces)
-                if isinstance(leaf, _ShardedLeaf)
-                else [tuple((0, d) for d in leaf.shape)]
-            )
+                piece_bounds = [
+                    b for b in piece_bounds if shard_filter(path, b)
+                ]
+
+            def _stage(x=leaf, p=path, pb=tuple(piece_bounds), idx=i):
+                if stage_hook is not None:
+                    stage_hook(idx, p)
+                with timed_span(metrics, "heal_stage"):
+                    staged = _ShardedLeaf(x)
+                    staged.pieces = {
+                        b: arr for b, arr in staged.pieces.items()
+                        if b in set(pb)
+                    }
+                return staged
+
+            slots.append(StealableTask(_stage))
             entries.append(
                 {
                     "path": path,
                     "kind": "ndarray",
-                    "dtype": str(leaf.dtype),
-                    "shape": tuple(leaf.shape),
-                    "nbytes": int(leaf.nbytes),
+                    "dtype": str(dtype),
+                    "shape": shape,
+                    "nbytes": int(
+                        np.prod(shape, dtype=np.int64) * dtype.itemsize
+                    ),
                     # global bounds of the pieces THIS host holds: the
                     # healer routes region fetches with these
-                    "pieces": pieces,
+                    "pieces": piece_bounds,
+                }
+            )
+        elif isinstance(leaf, np.ndarray):
+            with timed_span(metrics, "heal_stage"):
+                # detach from live training NOW (host arrays are
+                # mutable) — this memcpy is staging work like any D2H
+                snap = np.array(leaf, copy=True)
+            slots.append(StealableTask(lambda s=snap: s))
+            entries.append(
+                {
+                    "path": path,
+                    "kind": "ndarray",
+                    "dtype": str(snap.dtype),
+                    "shape": tuple(snap.shape),
+                    "nbytes": int(snap.nbytes),
+                    "pieces": [tuple((0, d) for d in snap.shape)],
                 }
             )
         else:
+            slots.append(StealableTask(lambda o=leaf: o))
             entries.append({"path": path, "kind": "object"})
+    for s in slots:
+        group.add(s.future)
     manifest = {
         "step": step,
         "leaves": entries,
         "treedef": treedef,
         "peers": list(peers or []),
     }
-    return _Staged(
+    staged = _Staged(
         step=step,
-        leaves=leaves,
+        slots=slots,
+        entries=entries,
         manifest_bytes=pickle.dumps(manifest, protocol=5),
         treedef=treedef,
+        all_staged=group.seal(lambda: None),
     )
+    if not lazy:
+        staged.finish_staging()
+    return staged
 
 
 class CheckpointTransport(ABC, Generic[T]):
@@ -289,8 +498,9 @@ class _Handler(BaseHTTPRequestHandler):
         (both sides act on the same quorum response concurrently), so the
         gate must WAIT, not fail (ref checkpointing.py:139-170 holds a
         lock while disallowed for the same reason). Returns the staged
-        bundle (an immutable host copy, safe to stream outside the gate),
-        or None after having sent an error response."""
+        bundle (its host copies materialize as slots run; the bundle is
+        safe to stream outside the gate), or None after having sent an
+        error response."""
         server: "CheckpointServer" = self.server.ckpt_server  # type: ignore[attr-defined]
         with server._cond:
             opened = server._cond.wait_for(
@@ -313,6 +523,30 @@ class _Handler(BaseHTTPRequestHandler):
                 return None
             return staged
 
+    def _send_tensor(self, arr: np.ndarray, dtype: np.dtype,
+                     wire_dtype: "Optional[np.dtype]") -> None:
+        """Stream one tensor region: headers + chunked writes of a byte
+        view over the (staged) array — no tobytes, no body
+        materialization. ``dtype`` is the staged dtype; ``wire_dtype``
+        (when set and the leaf is wire-compressible) downcasts on the
+        way out, which inherently allocates — it is the opt-in lossy
+        lever, never the default."""
+        view, wired = _wire_encode(arr, wire_dtype)
+        self.send_response(200)
+        self.send_header("X-Kind", "ndarray")
+        self.send_header("X-Dtype", str(dtype))
+        if wired is not None:
+            self.send_header("X-Wire-Dtype", str(wired))
+        self.send_header(
+            "X-Shape", ",".join(str(d) for d in arr.shape)
+        )
+        self.send_header("Content-Length", str(view.nbytes))
+        self.end_headers()
+        self._body_streaming = True
+        for off in range(0, view.nbytes, _SEND_CHUNK):
+            self.wfile.write(view[off: off + _SEND_CHUNK])
+        self._body_streaming = False
+
     def do_GET(self) -> None:  # noqa: N802
         from urllib.parse import parse_qs, urlparse
 
@@ -329,6 +563,7 @@ class _Handler(BaseHTTPRequestHandler):
         staged = self._await_staged(step)
         if staged is None:
             return
+        server: "CheckpointServer" = self.server.ckpt_server  # type: ignore[attr-defined]
 
         try:
             if len(parts) == 2:  # /checkpoint/{step} — full pickle stream
@@ -337,7 +572,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # must surface as an error status, not a torn body.
                 try:
                     full_state = staged.state
-                except ValueError as e:
+                except Exception as e:  # noqa: BLE001 — staging/coverage
                     self.send_error(503, str(e))
                     return
                 self.send_response(200)
@@ -347,8 +582,10 @@ class _Handler(BaseHTTPRequestHandler):
                 # Chunked-free streaming: close delimits the body.
                 self.send_header("Connection", "close")
                 self.end_headers()
+                self._body_streaming = True
                 # all-host copy (assembled once, cached on the stage)
                 pytree_to_stream(full_state, self.wfile, convert=False)
+                self._body_streaming = False
                 self.close_connection = True
                 return
 
@@ -363,35 +600,76 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(body)
                 return
 
-            if parts[2] == "leaves" and len(parts) == 4:
-                # /checkpoint/{step}/leaves/{lo}-{hi}: one pickled list of
-                # leaves[lo:hi] — lets a chunked receiver use exactly
-                # num_chunks connections instead of one per leaf.
+            # (the pre-streaming pickled /leaves/{lo}-{hi} endpoint is
+            # gone: rawleaves + /leaf cover every receiver, and tensor
+            # pickle now exists ONLY on the legacy full-stream path)
+
+            if parts[2] == "rawleaves" and len(parts) == 4:
+                # /checkpoint/{step}/rawleaves/{lo}-{hi}[?wire=bf16]:
+                # the leaves' tensor bytes BACK-TO-BACK, no framing —
+                # every length is derivable from the manifest the healer
+                # already holds, so ONE request moves a whole leaf range
+                # with zero pickle and zero per-leaf round trips. The
+                # Content-Length is computed from METADATA, so headers go
+                # out immediately and each leaf is staged just-in-time
+                # while earlier leaves are already on the wire (the
+                # stage/wire pipeline). A staging failure mid-stream
+                # surfaces as a short body, which the healer's bounded
+                # read turns into a retryable error.
                 lo_s, _, hi_s = parts[3].partition("-")
                 lo, hi = int(lo_s), int(hi_s)
-                if not (0 <= lo <= hi <= len(staged.leaves)):
+                if not (0 <= lo < hi <= staged.num_leaves):
                     self.send_error(404, f"bad leaf range {lo}-{hi}")
                     return
-                body = pickle.dumps(
-                    [_materialize_leaf(l) for l in staged.leaves[lo:hi]],
-                    protocol=5,
+                q = parse_qs(url.query)
+                wire = q.get("wire", [None])[0]
+                if wire is not None and wire not in _WIRE_DTYPES:
+                    self.send_error(400, f"unknown wire dtype {wire!r}")
+                    return
+                wire_dtype = (
+                    _WIRE_DTYPES[wire]() if wire is not None else None
                 )
+                sizes = []
+                for entry in staged.entries[lo:hi]:
+                    if entry["kind"] != "ndarray":
+                        self.send_error(
+                            400,
+                            f"leaf range {lo}-{hi} contains non-tensor "
+                            "leaves — fetch those via /leaf/{i}",
+                        )
+                        return
+                    sizes.append(_entry_wire_nbytes(entry, wire_dtype))
                 self.send_response(200)
-                self.send_header("Content-Length", str(len(body)))
+                self.send_header("X-Kind", "rawleaves")
+                self.send_header("X-Count", str(hi - lo))
+                self.send_header("Content-Length", str(sum(sizes)))
                 self.end_headers()
-                self.wfile.write(body)
+                self._body_streaming = True
+                server_timeout = server._timeout
+                for i in range(lo, hi):
+                    leaf = staged.leaf(i, server_timeout)  # JIT stage
+                    arr = (
+                        leaf.read()
+                        if isinstance(leaf, _ShardedLeaf) else leaf
+                    )
+                    view, _ = _wire_encode(arr, wire_dtype)
+                    for off in range(0, view.nbytes, _SEND_CHUNK):
+                        self.wfile.write(view[off: off + _SEND_CHUNK])
+                self._body_streaming = False
                 return
 
             if parts[2] == "leaf" and len(parts) == 4:
-                # /checkpoint/{step}/leaf/{i}[?slice=0:4,:,...]
-                # All slicing/serialization happens BEFORE headers are
-                # sent: a failure after send_response(200) could only
-                # corrupt the stream, not signal an error.
+                # /checkpoint/{step}/leaf/{i}[?slice=0:4,:...][&wire=bf16]
+                # All slicing/staging happens BEFORE headers are sent: a
+                # failure after send_response(200) could only corrupt the
+                # stream, not signal an error.
                 idx = int(parts[3])
-                if not (0 <= idx < len(staged.leaves)):
+                if not (0 <= idx < staged.num_leaves):
                     self.send_error(404, f"no leaf {idx}")
                     return
-                leaf = staged.leaves[idx]
+                # priority bump: stages leaf idx inline if the background
+                # stager has not reached it yet
+                leaf = staged.leaf(idx, server._timeout)
                 if not isinstance(leaf, (np.ndarray, _ShardedLeaf)):
                     body = pickle.dumps(leaf, protocol=5)
                     self.send_response(200)
@@ -400,33 +678,35 @@ class _Handler(BaseHTTPRequestHandler):
                     self.end_headers()
                     self.wfile.write(body)
                     return
-                spec = parse_qs(url.query).get("slice", [None])[0]
+                q = parse_qs(url.query)
+                spec = q.get("slice", [None])[0]
+                wire = q.get("wire", [None])[0]
+                if wire is not None and wire not in _WIRE_DTYPES:
+                    self.send_error(
+                        400,
+                        f"unknown wire dtype {wire!r} "
+                        f"(supported: {sorted(_WIRE_DTYPES)})",
+                    )
+                    return
+                wire_dtype = (
+                    _WIRE_DTYPES[wire]() if wire is not None else None
+                )
                 # Server-side shard slicing: only the healer's shard
                 # bytes cross the wire (SURVEY.md §7 hard part 3). For a
                 # shard-wise staged leaf, a matching-bounds request is
                 # served from the piece directly, no copies.
+                dtype = np.dtype(leaf.dtype)
                 if isinstance(leaf, _ShardedLeaf):
                     slices = (
                         _parse_slice_spec(spec, leaf.shape)
                         if spec is not None else None
                     )
-                    leaf = leaf.read(slices)
+                    arr = leaf.read(slices)
                 elif spec is not None:
-                    leaf = leaf[_parse_slice_spec(spec, leaf.shape)]
-                body_arr = np.ascontiguousarray(leaf)
-                # tobytes, not memoryview: ml_dtypes arrays (bfloat16,
-                # fp8) reject the buffer protocol's format codes.
-                body = body_arr.tobytes()
-                self.send_response(200)
-                self.send_header("X-Kind", "ndarray")
-                self.send_header("X-Dtype", str(body_arr.dtype))
-                self.send_header(
-                    "X-Shape",
-                    ",".join(str(d) for d in body_arr.shape),
-                )
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                    arr = leaf[_parse_slice_spec(spec, leaf.shape)]
+                else:
+                    arr = leaf
+                self._send_tensor(arr, dtype, wire_dtype)
                 return
 
             self.send_error(404, "unknown path")
@@ -434,6 +714,25 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_error(400, str(e))
         except (BrokenPipeError, ConnectionResetError):
             logger.warning("checkpoint receiver disconnected mid-stream")
+        except Exception as e:  # noqa: BLE001 — e.g. a leaf whose lazy
+            # staging failed (donated device buffer). Before headers:
+            # surface a 503 the healer can retry on. MID-BODY: never
+            # write an error response into the advertised byte stream
+            # (the healer would decode it as tensor payload) — close the
+            # connection abruptly so the bounded read sees a SHORT body
+            # and raises its prescriptive retryable error.
+            logger.exception("checkpoint serve failed: %s", e)
+            if getattr(self, "_body_streaming", False):
+                self.close_connection = True
+                try:
+                    self.connection.close()
+                except OSError:
+                    pass
+            else:
+                try:
+                    self.send_error(503, str(e)[:300])
+                except (OSError, ValueError):
+                    pass
 
 
 class CheckpointServer(CheckpointTransport[T]):
@@ -442,10 +741,15 @@ class CheckpointServer(CheckpointTransport[T]):
 
     def __init__(self, timeout: "float | timedelta" = 60.0,
                  num_chunks: int = 0,
-                 template_fn: "Optional[Any]" = None) -> None:
-        """``num_chunks``: when > 1, recv_checkpoint fetches the donor's
-        leaves over that many parallel HTTP connections instead of one
-        pickle stream (ref checkpointing.py num_chunks).
+                 template_fn: "Optional[Any]" = None,
+                 lazy_stage: bool = True,
+                 heal_wire_dtype: "Optional[str]" = None,
+                 stripe_bytes: int = 4 << 20) -> None:
+        """``num_chunks``: when >= 1, recv_checkpoint fetches the donor's
+        leaves raw over that many keep-alive connections (1 = a single
+        streaming connection) instead of the legacy one-shot pickle
+        stream, which ``num_chunks=0`` keeps (ref checkpointing.py
+        num_chunks).
 
         ``template_fn``: zero-arg callable returning the healer's CURRENT
         state dict (same pytree structure the donor serves). When set,
@@ -454,18 +758,38 @@ class CheckpointServer(CheckpointTransport[T]):
         shard slices are requested (sliced donor-side, so just shard bytes
         cross DCN) and the healed leaf is assembled directly onto the
         healer's devices with its existing sharding — the HSDP heal path
-        (SURVEY.md §7 hard part 3; fixes the device_get-assembled-arrays
-        limitation flagged in round 1)."""
+        (SURVEY.md §7 hard part 3).
+
+        ``lazy_stage``: stage leaves in the background/on-demand (the
+        streaming pipeline). False restores eager full-tree staging
+        inside send_checkpoint — the legacy A/B arm.
+
+        ``heal_wire_dtype``: opt-in lossy wire precision for this
+        healer's fetches ("bf16"); float leaves are downcast donor-side
+        and upcast on receive. Default None keeps heals bitwise.
+
+        ``stripe_bytes``: regions at least this large stripe across
+        multiple donors/connections (<=0 disables striping)."""
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
         self._timeout = float(timeout)
         self._num_chunks = int(num_chunks)
         self._template_fn = template_fn
+        self._lazy_stage = bool(lazy_stage)
+        if heal_wire_dtype is not None and heal_wire_dtype not in _WIRE_DTYPES:
+            raise ValueError(
+                f"heal_wire_dtype={heal_wire_dtype!r} unsupported "
+                f"(choose from {sorted(_WIRE_DTYPES)} or None)"
+            )
+        self._heal_wire_dtype = heal_wire_dtype
+        self._stripe_bytes = int(stripe_bytes)
+        self._metrics = None
         self._cond = threading.Condition()
         self._disallowed = True
         self._staged: Optional[_Staged] = None
         self._peers: List[str] = []
         self._shard_filter = None  # test seam: simulate multi-host staging
+        self._stage_hook = None    # test seam: observe/delay leaf staging
 
         self._server = ThreadingHTTPServer(("0.0.0.0", 0), _Handler)
         self._server.daemon_threads = True
@@ -489,23 +813,39 @@ class CheckpointServer(CheckpointTransport[T]):
     def metadata(self) -> str:
         return self._addr
 
+    def set_metrics(self, metrics) -> None:
+        """Share a Metrics sink (the Manager's) so heal stage/wire/H2D
+        spans and gauges land next to the step-pipeline timers."""
+        self._metrics = metrics
+
     def send_checkpoint(
         self, dst_ranks: List[int], step: int, state_dict: T,
         timeout: "float | timedelta",
     ) -> None:
-        # Stage a host copy NOW so later training-step mutations of
-        # device state can't tear the served bytes, then open the gate.
-        # jax.Array leaves are copied SHARD-wise (one D2H per addressable
-        # shard, never assembled) — the multi-host-correct donor layout.
+        # Build the manifest and per-leaf stage slots NOW (metadata only
+        # — no D2H), open the gate, then drain staging in the background:
+        # the healer's first fetch streams while later leaves are still
+        # leaving the device. np.ndarray host state is snapshot eagerly
+        # (mutable); jax.Arrays are immutable so the per-leaf D2H can
+        # happen lazily, priority-bumped by incoming requests.
         del dst_ranks  # HTTP transport serves whoever fetches
         staged = _build_staged(
             step, state_dict, peers=self._peers,
             shard_filter=self._shard_filter,
+            lazy=self._lazy_stage,
+            metrics=self._metrics,
+            stage_hook=self._stage_hook,
         )
         with self._cond:
             self._staged = staged
             self._disallowed = False
             self._cond.notify_all()
+        if self._lazy_stage:
+            def _drain(slots=staged.slots):
+                for slot in slots:
+                    slot.run()
+
+            _heal_executor("stage").submit(_drain)
 
     def set_peers(self, peers: List[str]) -> None:
         """Register the other hosts' checkpoint server addresses for this
@@ -516,9 +856,18 @@ class CheckpointServer(CheckpointTransport[T]):
 
     def disallow_checkpoint(self) -> None:
         with self._cond:
-            if not self._disallowed:
-                self._disallowed = True
-                self._staged = None
+            staged = self._staged
+            if self._disallowed:
+                return
+            self._disallowed = True
+            self._staged = None
+        # Outside the lock: drain residual lazy staging BEFORE returning
+        # control to the trainer — after this point the training step may
+        # donate device buffers, which would invalidate arrays a pending
+        # stage still needs. Normally free: the background stager has
+        # already drained during the step's wire time.
+        if staged is not None:
+            staged.finish_staging(self._timeout)
 
     def recv_checkpoint(
         self, src_rank: int, metadata: str, step: int,
@@ -527,19 +876,31 @@ class CheckpointServer(CheckpointTransport[T]):
         del src_rank
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
+        t0 = time.perf_counter()
         if self._template_fn is not None:
-            return recv_checkpoint_sharded(
+            out = recv_checkpoint_sharded(
                 metadata, step, self._template_fn(), float(timeout),
                 parallel=max(2, self._num_chunks),
+                metrics=self._metrics,
+                wire_dtype=self._heal_wire_dtype,
+                stripe_bytes=self._stripe_bytes,
             )
-        if self._num_chunks > 1:
-            return _recv_chunked(
-                metadata, step, self._num_chunks, float(timeout)
+        elif self._num_chunks >= 1:
+            out = _recv_chunked(
+                metadata, step, self._num_chunks, float(timeout),
+                metrics=self._metrics,
+                wire_dtype=self._heal_wire_dtype,
             )
-        url = f"{metadata}/checkpoint/{step}"
-        logger.info("fetching checkpoint from %s", url)
-        with urllib.request.urlopen(url, timeout=timeout) as resp:
-            return pytree_from_stream(resp)
+        else:
+            url = f"{metadata}/checkpoint/{step}"
+            logger.info("fetching checkpoint from %s", url)
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                out = pytree_from_stream(resp)
+        if self._metrics is not None:
+            self._metrics.gauge(
+                "heal_wall_ms", (time.perf_counter() - t0) * 1000.0
+            )
+        return out
 
     def shutdown(self, wait: bool = True) -> None:
         self._server.shutdown()
@@ -573,12 +934,154 @@ def _dtype_from_str(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def fetch_manifest(metadata: str, step: int, timeout: float = 60.0) -> dict:
+class _DonorConn:
+    """Thin keep-alive HTTP client for the heal plane.
+
+    urllib opens one TCP connection per request; a chunked/striped heal
+    issues hundreds of leaf requests, so each worker thread holds one of
+    these per donor host and reuses the socket (the server speaks
+    HTTP/1.1 with Content-Length on every raw endpoint). A stale
+    keep-alive socket (donor idle-closed it between steps) is retried
+    ONCE on a fresh connection; real donor death surfaces as the second
+    failure."""
+
+    def __init__(self, metadata: str, timeout: float) -> None:
+        from urllib.parse import urlparse
+
+        u = urlparse(metadata)
+        if u.hostname is None:
+            raise ValueError(f"bad donor address {metadata!r}")
+        self._host, self._port = u.hostname, u.port or 80
+        self._timeout = timeout
+        self._conn: "Optional[http.client.HTTPConnection]" = None
+
+    def _connect(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=self._timeout
+            )
+        return self._conn
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:  # pragma: no cover — best-effort
+                pass
+            self._conn = None
+
+    def get(self, path: str) -> http.client.HTTPResponse:
+        """GET returning the live response (caller MUST consume exactly
+        the advertised body for the connection to stay reusable). Non-200
+        raises urllib.error.HTTPError for parity with the urlopen-based
+        callers/tests."""
+        for attempt in (0, 1):
+            conn = self._connect()
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                break
+            except (http.client.HTTPException, OSError):
+                self.close()
+                if attempt:
+                    raise
+        if resp.status != 200:
+            body = resp.read()
+            self.close()  # error bodies may lack lengths; start fresh
+            raise urllib.error.HTTPError(
+                f"http://{self._host}:{self._port}{path}",
+                resp.status,
+                body.decode(errors="replace")[:500],
+                resp.headers,
+                io.BytesIO(body),
+            )
+        return resp
+
+
+class _ConnPool:
+    """Keep-alive donor connections shared across fetch workers, keyed
+    by host: acquire per request, release only after the body was
+    consumed exactly (a conn with stale bytes must be CLOSED, not
+    released — the next request on it would parse tensor bytes as a
+    status line), close_all when the heal ends (a leaked conn pins a
+    blocked donor handler thread until GC). The single implementation
+    behind both the sharded and chunked receivers."""
+
+    def __init__(self, timeout: float) -> None:
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._idle: "Dict[str, List[_DonorConn]]" = {}
+        self._all: "List[_DonorConn]" = []
+
+    def acquire(self, host: str) -> _DonorConn:
+        with self._lock:
+            idle = self._idle.setdefault(host, [])
+            if idle:
+                return idle.pop()
+        c = _DonorConn(host, self._timeout)
+        with self._lock:
+            self._all.append(c)
+        return c
+
+    def release(self, host: str, conn: _DonorConn) -> None:
+        with self._lock:
+            self._idle.setdefault(host, []).append(conn)
+
+    def close_all(self) -> None:
+        with self._lock:
+            for c in self._all:
+                c.close()
+
+
+def fetch_manifest(metadata: str, step: int, timeout: float = 60.0,
+                   conn: "Optional[_DonorConn]" = None) -> dict:
     """Fetch the donor's leaf manifest: {step, leaves: [{path, kind, dtype,
-    shape, nbytes}...], treedef}."""
+    shape, nbytes, pieces}...], treedef, peers}. Pass ``conn`` to ride an
+    existing keep-alive donor connection (the urllib opener chain costs
+    several ms per call — measurable against a small manifest)."""
+    if conn is not None:
+        resp = conn.get(f"/checkpoint/{step}/manifest")
+        clen = int(resp.headers["Content-Length"])
+        body = resp.read(clen)
+        if len(body) != clen:
+            raise ConnectionError(
+                f"manifest truncated at {len(body)}/{clen} bytes"
+            )
+        return pickle.loads(body)
     url = f"{metadata}/checkpoint/{step}/manifest"
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         return pickle.load(resp)
+
+
+def _read_wire_tensor(resp, dtype: np.dtype, shape: tuple,
+                      wire_np: np.dtype, what: str,
+                      out: "Optional[np.ndarray]" = None) -> np.ndarray:
+    """Land one tensor body from ``resp``: readinto a preallocated (or
+    fresh) array in the staged dtype, via a wire-dtype temporary + upcast
+    when the opt-in lossy encoding is active. The single implementation
+    behind BOTH fetch_leaf and the rawleaves range reader."""
+    if wire_np == dtype:
+        target = out if out is not None else np.empty(shape, dtype)
+        readinto_exact(resp, as_bytes_view(target), what=what)
+        return target
+    wire_arr = np.empty(shape, wire_np)
+    readinto_exact(resp, as_bytes_view(wire_arr), what=what)
+    if out is not None:
+        out[...] = wire_arr.astype(dtype)
+        return out
+    return wire_arr.astype(dtype)
+
+
+def _leaf_path(step: int, index: int,
+               slices: "Optional[Sequence[slice]]",
+               wire_dtype: "Optional[str]") -> str:
+    path = f"/checkpoint/{step}/leaf/{index}"
+    params = []
+    if slices is not None:
+        params.append("slice=" + format_slice_spec(slices))
+    if wire_dtype is not None:
+        params.append(f"wire={wire_dtype}")
+    return path + ("?" + "&".join(params) if params else "")
 
 
 def fetch_leaf(
@@ -587,34 +1090,72 @@ def fetch_leaf(
     index: int,
     slices: Optional[Sequence[slice]] = None,
     timeout: float = 60.0,
+    out: "Optional[np.ndarray]" = None,
+    wire_dtype: "Optional[str]" = None,
+    conn: "Optional[_DonorConn]" = None,
 ) -> Any:
-    """Fetch one leaf (optionally a server-sliced shard of it) by index."""
-    url = f"{metadata}/checkpoint/{step}/leaf/{index}"
-    if slices is not None:
-        url += "?slice=" + format_slice_spec(slices)
-    with urllib.request.urlopen(url, timeout=timeout) as resp:
+    """Fetch one leaf (optionally a server-sliced shard of it) by index.
+
+    Reads are BOUNDED by the advertised Content-Length, which is itself
+    cross-checked against the dtype/shape headers — a mismatch raises a
+    prescriptive error instead of a downstream frombuffer shape crash.
+    ``out``: preallocated C-contiguous destination (dtype/shape must
+    match); the body is ``readinto`` it with no intermediate bytes.
+    ``wire_dtype``: request the opt-in lossy wire encoding ("bf16");
+    the result is upcast back to the staged dtype. ``conn``: reuse a
+    keep-alive donor connection (callers doing many fetches)."""
+    own_conn = conn is None
+    if own_conn:
+        conn = _DonorConn(metadata, timeout)
+    try:
+        resp = conn.get(_leaf_path(step, index, slices, wire_dtype))
         kind = resp.headers.get("X-Kind", "ndarray")
+        clen_hdr = resp.headers.get("Content-Length")
+        if clen_hdr is None:
+            raise ConnectionError(
+                "donor sent no Content-Length for leaf "
+                f"{index} — refusing an unbounded read"
+            )
+        clen = int(clen_hdr)
         if kind == "object":
-            return pickle.loads(resp.read())
-        dtype = _dtype_from_str(resp.headers["X-Dtype"])
-        shape_hdr = resp.headers["X-Shape"]
-        shape = tuple(
-            int(d) for d in shape_hdr.split(",") if d
-        )
-        # Read into a mutable buffer: frombuffer over `bytes` would make
-        # the healed leaf read-only, breaking later in-place updates.
-        nbytes = int(resp.headers["Content-Length"])
-        buf = bytearray(nbytes)
-        view = memoryview(buf)
-        off = 0
-        while off < nbytes:
-            got = resp.readinto(view[off:])
-            if not got:
+            body = resp.read(clen)
+            if len(body) != clen:
                 raise ConnectionError(
-                    f"leaf body truncated at {off}/{nbytes} bytes"
+                    f"object leaf {index} body truncated at "
+                    f"{len(body)}/{clen} bytes — donor died mid-stream; "
+                    "refetch from a live peer"
                 )
-            off += got
-        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+            return pickle.loads(body)
+        dtype = _dtype_from_str(resp.headers["X-Dtype"])
+        shape = tuple(
+            int(d) for d in resp.headers["X-Shape"].split(",") if d
+        )
+        wire_hdr = resp.headers.get("X-Wire-Dtype")
+        wire_dt = _dtype_from_str(wire_hdr) if wire_hdr else dtype
+        expect = int(np.prod(shape, dtype=np.int64)) * wire_dt.itemsize
+        if clen != expect:
+            raise ConnectionError(
+                f"leaf {index}: advertised Content-Length {clen} != "
+                f"{expect} implied by dtype={wire_dt} shape={shape} — "
+                "donor/healer version skew or corrupt stream; refusing "
+                "to decode"
+            )
+        if out is not None:
+            if tuple(out.shape) != shape or out.dtype != dtype:
+                raise ValueError(
+                    f"out buffer {out.dtype}{tuple(out.shape)} does not "
+                    f"match leaf {dtype}{shape}"
+                )
+            if not out.flags.c_contiguous:
+                raise ValueError(
+                    "out buffer must be C-contiguous for recv-into"
+                )
+        return _read_wire_tensor(
+            resp, dtype, shape, wire_dt, f"leaf {index} body", out=out
+        )
+    finally:
+        if own_conn:
+            conn.close()
 
 
 def _normalize_index(index, shape) -> "tuple[tuple[int, int], ...]":
@@ -705,12 +1246,55 @@ def _route_region(bounds, piece_maps):
     return plan
 
 
+def _covering_hosts(bounds, piece_maps, dead=()) -> List[str]:
+    """Hosts whose shard pieces fully contain ``bounds`` (stripe/retry
+    candidates), dead hosts excluded."""
+    return [
+        host
+        for host, pieces in piece_maps.items()
+        if host not in dead
+        and any(_intersect(bounds, p) == bounds for p in pieces)
+    ]
+
+
+def _stripe_region(bounds, nbytes: int, stripe_bytes: int,
+                   parallel: int) -> "Optional[List[tuple]]":
+    """Deterministic stripe grid for one region: contiguous dim-0 bands
+    of roughly ``stripe_bytes`` each (so each stripe lands in a
+    contiguous slab of the preallocated region buffer). Returns None
+    when the region is too small / unsplittable. The resulting set is
+    exact-cover verified geometrically, like the gradient transport's
+    chunk grid."""
+    if stripe_bytes <= 0 or nbytes < 2 * stripe_bytes:
+        return None
+    rows = bounds[0][1] - bounds[0][0]
+    if rows < 2:
+        return None
+    want = min(
+        max(2, nbytes // stripe_bytes), max(2, parallel), rows
+    )
+    base = bounds[0][0]
+    stripes = [
+        ((base + a, base + b),) + tuple(bounds[1:])
+        for a, b in split_stripes(rows, want)
+    ]
+    if not _covers_exactly(bounds, stripes):  # pragma: no cover — grid
+        # construction is exact by construction; this guards refactors
+        raise ValueError(
+            f"stripe grid does not exactly cover region {bounds}"
+        )
+    return stripes
+
+
 def recv_checkpoint_sharded(
     metadata: str,
     step: int,
     template: Any,
     timeout: float = 60.0,
     parallel: int = 4,
+    metrics: "Optional[Any]" = None,
+    wire_dtype: "Optional[str]" = None,
+    stripe_bytes: int = 4 << 20,
 ) -> Any:
     """Sharding-aware heal fetch: for each leaf whose ``template``
     counterpart is a jax.Array, fetch only the slices this process's
@@ -719,12 +1303,30 @@ def recv_checkpoint_sharded(
     fetched whole. The donor and healer must run the same model — leaf
     paths are cross-checked against the donor's manifest.
 
+    Streaming pipeline: every region lands via ``readinto`` in a
+    preallocated host buffer cut from the template's dtype/shape (no
+    intermediate bytes + frombuffer copy); regions >= ``stripe_bytes``
+    stripe across every donor host that holds them AND multiple parallel
+    keep-alive connections; each leaf's H2D (device assembly) is
+    submitted to a bounded worker the moment its last region lands, so
+    device uploads overlap with in-flight network receives.
+
     Multi-host fan-out: when a needed region is not fully held by the
     primary donor host, the manifest's ``peers`` addresses are consulted
     (their manifests fetched once) and each region — split per piece when
-    it spans hosts — is fetched from a host that owns it."""
+    it spans hosts — is fetched from a host that owns it. A donor that
+    dies MID-STREAM fails only its in-flight fetches: each is retried
+    against the surviving hosts that cover the same bounds, and the heal
+    either completes whole or raises — no partial state is ever
+    returned.
+
+    ``timeout`` bounds each individual wait (socket ops, per-leaf
+    result joins) — the transport-wide idle-deadline convention, NOT an
+    end-to-end wall clock; a heal that keeps making progress is never
+    killed mid-recovery."""
     import jax
 
+    t0 = time.perf_counter()
     manifest = fetch_manifest(metadata, step, timeout=timeout)
     entries = manifest["leaves"]
     t_flat, t_def = jax.tree_util.tree_flatten_with_path(template)
@@ -744,12 +1346,18 @@ def recv_checkpoint_sharded(
     # Per-host piece maps, lazily extended with peer manifests only if
     # some region is not covered by the primary host.
     manifests = {metadata: manifest}
+    peers_lock = threading.Lock()  # guards manifests + peers_left
     peers_left = [p for p in manifest.get("peers", []) if p != metadata]
 
     def _piece_maps(leaf_idx: int, shape) -> dict:
         full = tuple((0, d) for d in shape)
         out = {}
-        for host, m in manifests.items():
+        # snapshot under the lock: a fetch worker's donor-death failover
+        # inserts peer manifests concurrently (_pull_locked), and a dict
+        # mutated mid-iteration raises in THIS thread
+        with peers_lock:
+            items = list(manifests.items())
+        for host, m in items:
             entry = m["leaves"][leaf_idx]
             out[host] = [
                 tuple(tuple(b) for b in p)
@@ -757,37 +1365,50 @@ def recv_checkpoint_sharded(
             ]
         return out
 
+    def _pull_peer_manifests() -> None:
+        # pull all peer manifests (once, in parallel — a serial walk
+        # would stall recovery by a full RTT per donor host); also
+        # called from fetch workers on a donor death, so alternates
+        # exist even when planning never needed the peers. The lock is
+        # held THROUGH the pull: a second worker racing in here must
+        # not observe "peers already claimed" while the manifests dict
+        # is still empty — it would conclude no peer covers its region.
+        with peers_lock:
+            if not peers_left:
+                return
+            pending = list(peers_left)
+            _pull_locked(pending)
+            peers_left.clear()
+
+    def _pull_locked(pending) -> None:
+        def _pull(peer):
+            try:
+                return peer, fetch_manifest(peer, step, timeout=timeout)
+            except Exception as e:  # noqa: BLE001 — a dead peer only
+                # narrows coverage; the final route raises if coverage
+                # stays short
+                logger.warning(
+                    "peer manifest fetch failed %s: %s", peer, e
+                )
+                return peer, None
+        with ThreadPoolExecutor(
+            max_workers=max(1, min(len(pending), parallel))
+        ) as pool:
+            for peer, m in pool.map(_pull, pending):
+                if m is not None:
+                    manifests[peer] = m
+
     def _plan_region(leaf_idx, shape, bounds):
         try:
             return _route_region(bounds, _piece_maps(leaf_idx, shape))
         except ValueError:
-            # pull all peer manifests (once, in parallel — a serial walk
-            # would stall recovery by a full RTT per donor host) and
-            # retry before giving up
             if peers_left:
-                def _pull(peer):
-                    try:
-                        return peer, fetch_manifest(
-                            peer, step, timeout=timeout
-                        )
-                    except Exception as e:  # noqa: BLE001 — a dead peer
-                        # only narrows coverage; the final route raises
-                        # if coverage stays short
-                        logger.warning(
-                            "peer manifest fetch failed %s: %s", peer, e
-                        )
-                        return peer, None
-                with ThreadPoolExecutor(
-                    max_workers=max(1, min(len(peers_left), parallel))
-                ) as pool:
-                    for peer, m in pool.map(_pull, peers_left):
-                        if m is not None:
-                            manifests[peer] = m
-                peers_left.clear()
+                _pull_peer_manifests()
             return _route_region(bounds, _piece_maps(leaf_idx, shape))
 
     # Plan all fetches first (unique shard slices per leaf, routed to the
-    # owning host), pull them in parallel, then assemble on-device.
+    # owning host), then stream them through the fetch pool with per-leaf
+    # completion groups driving the H2D worker.
     plans = []  # (leaf_index, entry, tleaf, {bounds: [(host, sub)...]})
     for i, ((kp, tleaf), entry) in enumerate(zip(t_flat, entries)):
         if entry["kind"] == "ndarray" and isinstance(tleaf, jax.Array):
@@ -816,96 +1437,393 @@ def recv_checkpoint_sharded(
         else:
             plans.append((i, entry, tleaf, None))
 
-    def _fetch(job):
-        host, i, bounds = job
-        if bounds is None:
-            return fetch_leaf(host, step, i, timeout=timeout)
-        return fetch_leaf(
-            host, step, i, slices=_bounds_to_slices(bounds),
-            timeout=timeout,
-        )
+    # ---- streamed fetch + overlapped H2D --------------------------------
+    dead_hosts: set = set()
+    dead_lock = threading.Lock()
+    total_bytes = [0]
+    bytes_lock = threading.Lock()  # += is not atomic across workers
 
-    jobs = set()
-    for i, entry, tleaf, routed in plans:
-        if routed is None:
-            jobs.add((metadata, i, None))
-        else:
-            for sub in routed.values():
-                jobs.update((host, i, b) for host, b in sub)
-    jobs = sorted(jobs)
-    with ThreadPoolExecutor(max_workers=max(1, parallel)) as pool:
-        fetched = list(pool.map(_fetch, jobs))
-    results_by_job = dict(zip(jobs, fetched))
+    conn_pool = _ConnPool(timeout)
 
-    leaves = []
-    for i, entry, tleaf, routed in plans:
-        if routed is None:
-            leaves.append(results_by_job[(metadata, i, None)])
-            continue
-        shape = tuple(entry["shape"])
-        shards = {}
-        for bounds, sub in routed.items():
-            if len(sub) == 1 and sub[0][1] == bounds:
-                host, _ = sub[0]
-                arr = results_by_job[(host, i, bounds)]
-            else:  # spans hosts: assemble the region from its pieces
-                arr = np.empty(
-                    tuple(b - a for a, b in bounds),
-                    dtype=_dtype_from_str(entry["dtype"]),
+    _NET_ERRORS = (
+        urllib.error.URLError, http.client.HTTPException,
+        ConnectionError, socket.timeout, TimeoutError, OSError,
+    )
+
+    def _fetch_once(host, i, fetch_bounds, out):
+        nb = [0]
+        with throughput_span(metrics, "heal_wire", nb):
+            conn = conn_pool.acquire(host)
+            try:
+                got = fetch_leaf(
+                    host, step, i,
+                    slices=(
+                        _bounds_to_slices(fetch_bounds)
+                        if fetch_bounds is not None else None
+                    ),
+                    timeout=timeout, out=out, wire_dtype=wire_dtype,
+                    conn=conn,
                 )
-                for host, piece_b in sub:
-                    dst = tuple(
-                        slice(a - ra, b - ra)
-                        for (a, b), (ra, _) in zip(piece_b, bounds)
+            except BaseException:
+                conn.close()  # possibly mid-body: stale, not reusable
+                raise
+            conn_pool.release(host, conn)
+            if isinstance(got, np.ndarray):
+                # count WIRE bytes: under the opt-in lossy encoding the
+                # socket moved the downcast payload, not the upcast copy
+                wire_nb = got.nbytes
+                if (wire_dtype is not None
+                        and got.dtype in _WIRE_COMPRESSIBLE):
+                    wire_nb = (
+                        got.size * _WIRE_DTYPES[wire_dtype]().itemsize
                     )
-                    arr[dst] = results_by_job[(host, i, piece_b)]
-            # dtype equality is already enforced against the manifest
-            shards[bounds] = np.asarray(arr)
+                nb[0] = wire_nb
+                with bytes_lock:
+                    total_bytes[0] += wire_nb
+        return got
 
-        def _cb(index, _shards=shards, _shape=shape):
-            return _shards[_normalize_index(index, _shape)]
+    def _fetch_job(host, i, fetch_bounds, out, alternates):
+        """One wire fetch with donor-death failover: on a network error
+        the host is marked dead and the SAME bounds are refetched from
+        each surviving host that covers them."""
+        try:
+            return _fetch_once(host, i, fetch_bounds, out)
+        except urllib.error.HTTPError:
+            raise  # donor answered: a protocol error, not a death
+        except _NET_ERRORS as first:
+            with dead_lock:
+                dead_hosts.add(host)
+            # a donor death is exactly when the peer manifests become
+            # load-bearing — pull them before computing alternates
+            try:
+                _pull_peer_manifests()
+            except Exception:  # noqa: BLE001 — alternates just narrow
+                pass
+            for alt in alternates():
+                logger.warning(
+                    "donor %s died mid-stream; refetching leaf %d "
+                    "%s from %s", host, i, fetch_bounds, alt,
+                )
+                try:
+                    return _fetch_once(alt, i, fetch_bounds, out)
+                except _NET_ERRORS:
+                    with dead_lock:
+                        dead_hosts.add(alt)
+            raise ConnectionError(
+                f"leaf {i} bounds {fetch_bounds}: donor {host} died and "
+                "no surviving peer covers the region"
+            ) from first
 
-        leaves.append(
-            jax.make_array_from_callback(shape, tleaf.sharding, _cb)
-        )
+    h2d_ex = _heal_executor("h2d")
+    fetch_pool = ThreadPoolExecutor(
+        max_workers=max(1, parallel),
+        thread_name_prefix="torchft_tpu_heal_fetch",
+    )
+    leaf_results: "List[Optional[Future]]" = [None] * len(plans)
+    try:
+        for i, entry, tleaf, routed in plans:
+            group = FutureGroup()
+            if routed is None:
+                # whole-leaf fetch (object or non-jax template leaf);
+                # ndarray leaves still land via readinto into a
+                # preallocated buffer
+                out_buf = None
+                if entry["kind"] == "ndarray":
+                    out_buf = np.empty(
+                        tuple(entry["shape"]),
+                        _dtype_from_str(entry["dtype"]),
+                    )
+
+                def _alts(i=i, shape=tuple(entry.get("shape", ()))):
+                    maps = _piece_maps(i, shape) if shape else {
+                        h: [] for h in manifests
+                    }
+                    with dead_lock:
+                        dead = set(dead_hosts)
+                    if shape:
+                        full = tuple((0, d) for d in shape)
+                        return [
+                            h for h in _covering_hosts(full, maps, dead)
+                            if h != metadata
+                        ]
+                    return [
+                        h for h in manifests
+                        if h not in dead and h != metadata
+                    ]
+
+                leaf_results[i] = fetch_pool.submit(
+                    _fetch_job, metadata, i, None, out_buf, _alts
+                )
+                continue
+
+            shape = tuple(entry["shape"])
+            dtype = _dtype_from_str(entry["dtype"])
+            maps = _piece_maps(i, shape)
+            region_bufs: dict = {}
+            for bounds, sub in routed.items():
+                buf = np.empty(
+                    tuple(b - a for a, b in bounds), dtype
+                )
+                region_bufs[bounds] = buf
+                region_nbytes = int(buf.nbytes)
+
+                if len(sub) == 1 and sub[0][1] == bounds:
+                    host = sub[0][0]
+                    stripes = _stripe_region(
+                        bounds, region_nbytes, stripe_bytes, parallel
+                    )
+                    if stripes is not None:
+                        # multi-donor, multi-connection striped fetch:
+                        # stripe s goes to covering host s % n (every
+                        # covering host shares the load; single-host
+                        # donors still win connection parallelism).
+                        # Hosts already marked dead by an earlier leaf's
+                        # failover don't get fresh stripes.
+                        with dead_lock:
+                            dead_now = set(dead_hosts)
+                        hosts = _covering_hosts(
+                            bounds, maps, dead_now
+                        ) or [host]
+                        base0 = bounds[0][0]
+                        for s_idx, sb in enumerate(stripes):
+                            dst = buf[
+                                sb[0][0] - base0: sb[0][1] - base0
+                            ]
+                            def _salts(sb=sb, i=i, shape=shape):
+                                with dead_lock:
+                                    dead = set(dead_hosts)
+                                return _covering_hosts(
+                                    sb, _piece_maps(i, shape), dead
+                                )
+                            group.add(fetch_pool.submit(
+                                _fetch_job,
+                                hosts[s_idx % len(hosts)],
+                                i, sb, dst, _salts,
+                            ))
+                    else:
+                        def _ralts(bounds=bounds, i=i, shape=shape):
+                            with dead_lock:
+                                dead = set(dead_hosts)
+                            return _covering_hosts(
+                                bounds, _piece_maps(i, shape), dead
+                            )
+                        group.add(fetch_pool.submit(
+                            _fetch_job, host, i, bounds, buf, _ralts
+                        ))
+                else:
+                    # region spans hosts: fetch each piece (no out
+                    # buffer — piece destinations may be mid-dim and
+                    # non-contiguous), copy into the region buffer
+                    for host, piece_b in sub:
+                        dst = tuple(
+                            slice(a - ra, b - ra)
+                            for (a, b), (ra, _) in zip(piece_b, bounds)
+                        )
+
+                        def _piece_fetch(host=host, i=i,
+                                         piece_b=piece_b, dst=dst,
+                                         buf=buf, shape=shape):
+                            def _palts():
+                                with dead_lock:
+                                    dead = set(dead_hosts)
+                                return _covering_hosts(
+                                    piece_b, _piece_maps(i, shape), dead
+                                )
+                            arr = _fetch_job(
+                                host, i, piece_b, None, _palts
+                            )
+                            buf[dst] = arr
+
+                        group.add(fetch_pool.submit(_piece_fetch))
+
+            def _assemble(tleaf=tleaf, shape=shape,
+                          region_bufs=region_bufs):
+                with timed_span(metrics, "heal_h2d"):
+                    shards = {
+                        b: np.asarray(a) for b, a in region_bufs.items()
+                    }
+
+                    def _cb(index, _shards=shards, _shape=shape):
+                        return _shards[_normalize_index(index, _shape)]
+
+                    return jax.make_array_from_callback(
+                        shape, tleaf.sharding, _cb
+                    )
+
+            sealed = group.seal(lambda: None)
+            # H2D overlaps in-flight receives: the moment this leaf's
+            # last region lands, its device assembly rides the bounded
+            # worker while the fetch pool keeps streaming later leaves.
+            leaf_results[i] = future_chain(
+                sealed,
+                lambda f, a=_assemble: (f.result(), h2d_ex.submit(a))[1],
+            )
+
+        leaves = []
+        for i, entry, tleaf, routed in plans:
+            # No wall clock on the fetch join: every underlying job is
+            # already bounded by per-socket idle deadlines and a finite
+            # retry set, so this settles exactly when they do — a huge
+            # leaf that keeps making wire progress is never killed (the
+            # idle-deadline contract above). The H2D result keeps
+            # ``timeout`` as a device-hang backstop.
+            got = leaf_results[i].result()
+            if routed is None:
+                # the fetch job future carries the fetched object/array
+                leaves.append(got)
+            else:
+                leaves.append(got.result(timeout))
+    finally:
+        fetch_pool.shutdown(wait=True, cancel_futures=True)
+        conn_pool.close_all()
+
+    if metrics is not None:
+        # heal_wall_ms is gauged by the callers that own the full span
+        # (CheckpointServer.recv_checkpoint / Manager at apply time)
+        wall = time.perf_counter() - t0
+        if total_bytes[0] and wall > 0:
+            metrics.gauge("heal_bytes_per_s", total_bytes[0] / wall)
     return jax.tree_util.tree_unflatten(t_def, leaves)
 
 
-def _fetch_leaf_range(
-    metadata: str, step: int, lo: int, hi: int, timeout: float
-) -> List[Any]:
-    url = f"{metadata}/checkpoint/{step}/leaves/{lo}-{hi}"
-    with urllib.request.urlopen(url, timeout=timeout) as resp:
-        return pickle.load(resp)
-
-
 def _recv_chunked(
-    metadata: str, step: int, num_chunks: int, timeout: float
+    metadata: str, step: int, num_chunks: int, timeout: float,
+    metrics: "Optional[Any]" = None,
+    wire_dtype: "Optional[str]" = None,
 ) -> Any:
-    """Parallel transfer over exactly num_chunks connections: the leaf
-    index space is split into contiguous ranges, one request per range,
-    reassembled with the donor's treedef."""
+    """Parallel transfer over ``num_chunks`` keep-alive connections:
+    tensor leaves ride the RAW multi-leaf stream (``rawleaves`` ranges:
+    back-to-back tensor bytes readinto preallocated arrays — no pickle
+    for tensor data, closing that trust surface, and no per-leaf round
+    trips; the donor stages each leaf just-in-time while earlier leaves
+    are on the wire), reassembled with the donor's treedef. Pickle
+    remains for the manifest and non-tensor object leaves."""
     import jax
 
-    manifest = fetch_manifest(metadata, step, timeout=timeout)
-    n = len(manifest["leaves"])
-    bounds = [
-        (n * k // num_chunks, n * (k + 1) // num_chunks)
-        for k in range(num_chunks)
-    ]
-    bounds = [(lo, hi) for lo, hi in bounds if hi > lo]
-    logger.info(
-        "fetching checkpoint step %d: %d leaves over %d connections",
-        step, n, len(bounds),
+    t0 = time.perf_counter()
+    conn_pool = _ConnPool(timeout)
+
+    first_conn = conn_pool.acquire(metadata)
+    manifest = fetch_manifest(
+        metadata, step, timeout=timeout, conn=first_conn
     )
-    with ThreadPoolExecutor(max_workers=max(1, len(bounds))) as pool:
-        ranges = list(
-            pool.map(
-                lambda b: _fetch_leaf_range(
-                    metadata, step, b[0], b[1], timeout
-                ),
-                bounds,
-            )
+    conn_pool.release(metadata, first_conn)
+    entries = manifest["leaves"]
+    n = len(entries)
+    num_chunks = max(1, num_chunks)
+    outs: List[Any] = [None] * n
+    total = [0]
+    total_lock = threading.Lock()  # += is not atomic across workers
+
+    # contiguous index ranges balanced by BYTES (a byte-balanced split
+    # keeps every connection busy for roughly the whole transfer; leaf
+    # counts alone can put 90% of the state on one connection)
+    tensor_idx = [
+        i for i, e in enumerate(entries) if e["kind"] == "ndarray"
+    ]
+    object_idx = [
+        i for i, e in enumerate(entries) if e["kind"] != "ndarray"
+    ]
+    ranges: List[tuple] = []
+    if tensor_idx:
+        wire_np = (
+            _WIRE_DTYPES[wire_dtype]() if wire_dtype is not None else None
         )
-    leaves = [leaf for r in ranges for leaf in r]
-    return jax.tree_util.tree_unflatten(manifest["treedef"], leaves)
+        budget = sum(
+            _entry_wire_nbytes(entries[i], wire_np) for i in tensor_idx
+        ) / float(num_chunks)
+        run_start, run_bytes = None, 0
+        prev = None
+        for i in tensor_idx:
+            if run_start is None:
+                run_start, run_bytes = i, 0
+            elif i != prev + 1 or (
+                run_bytes >= budget and len(ranges) < num_chunks - 1
+            ):
+                ranges.append((run_start, prev + 1))
+                run_start, run_bytes = i, 0
+            run_bytes += _entry_wire_nbytes(entries[i], wire_np)
+            prev = i
+        ranges.append((run_start, prev + 1))
+    logger.info(
+        "fetching checkpoint step %d: %d leaves over %d connections "
+        "(%d raw ranges)", step, n, num_chunks, len(ranges),
+    )
+
+    def _fetch_range(r: tuple) -> None:
+        lo, hi = r
+        nb = [0]
+        with throughput_span(metrics, "heal_wire", nb):
+            _fetch_range_inner(lo, hi, nb)
+
+    def _fetch_range_inner(lo: int, hi: int, nb: list) -> None:
+        path = f"/checkpoint/{step}/rawleaves/{lo}-{hi}"
+        if wire_dtype is not None:
+            path += f"?wire={wire_dtype}"
+        conn = conn_pool.acquire(metadata)
+        try:
+            resp = conn.get(path)
+            clen = int(resp.headers["Content-Length"])
+            got = 0
+            for i in range(lo, hi):
+                entry = entries[i]
+                dtype = _dtype_from_str(entry["dtype"])
+                shape = tuple(entry["shape"])
+                wire_np = (
+                    _WIRE_DTYPES[wire_dtype]()
+                    if wire_dtype is not None
+                    and dtype in _WIRE_COMPRESSIBLE
+                    else dtype
+                )
+                outs[i] = _read_wire_tensor(
+                    resp, dtype, shape, wire_np, f"leaf {i} body"
+                )
+                # count WIRE bytes (the downcast payload under the
+                # opt-in lossy encoding, not the upcast copy)
+                wire_nb = _entry_wire_nbytes(entry, (
+                    wire_np if wire_np != dtype else None
+                ))
+                got += wire_nb
+                with total_lock:
+                    total[0] += wire_nb
+                nb[0] += wire_nb
+            if got != clen:
+                raise ConnectionError(
+                    f"rawleaves {lo}-{hi}: advertised Content-Length "
+                    f"{clen} != {got} implied by the manifest — "
+                    "donor/healer version skew; refusing to desync "
+                    "the stream"
+                )
+        except BaseException:
+            # possibly mid-body or with unread trailing bytes: stale,
+            # must not be reused by a concurrent worker
+            conn.close()
+            raise
+        conn_pool.release(metadata, conn)
+
+    def _fetch_object(i: int) -> None:
+        conn = conn_pool.acquire(metadata)
+        try:
+            outs[i] = fetch_leaf(
+                metadata, step, i, timeout=timeout, conn=conn
+            )
+        except BaseException:
+            conn.close()
+            raise
+        conn_pool.release(metadata, conn)
+
+    try:
+        with ThreadPoolExecutor(max_workers=num_chunks) as pool:
+            futs = [pool.submit(_fetch_range, r) for r in ranges]
+            futs += [pool.submit(_fetch_object, i) for i in object_idx]
+            for f in futs:
+                f.result()
+    finally:
+        # keep-alive conns die with the heal, not with GC: a leaked conn
+        # pins a blocked donor handler thread until the socket collects
+        conn_pool.close_all()
+    if metrics is not None:
+        wall = time.perf_counter() - t0
+        if total[0] and wall > 0:
+            metrics.gauge("heal_bytes_per_s", total[0] / wall)
+    return jax.tree_util.tree_unflatten(manifest["treedef"], outs)

@@ -72,6 +72,17 @@ import numpy as np
 
 from torchft_tpu.comm.context import CommContext, ReduceOp, Work
 from torchft_tpu.comm.store import create_store_client
+from torchft_tpu.comm.wire import (
+    HAS_SENDMSG as _HAS_SENDMSG,
+    IOV_MAX as _IOV_MAX,
+    as_bytes_view as _as_bytes_view,
+    bf16_wire_dtype as _bf16_dtype,
+    iov_join as _iov_join,
+    iov_nbytes as _iov_nbytes,
+    recv_exact as _recv_exact,
+    recv_into_exact as _recv_into_exact,
+    sendmsg_all as _sendmsg_all,
+)
 from torchft_tpu.utils.metrics import Metrics
 
 logger = logging.getLogger(__name__)
@@ -88,51 +99,10 @@ _REDUCE_FNS = {
     ReduceOp.MIN: lambda a, b: np.minimum(a, b, out=a),
 }
 
-# Linux UIO_MAXIOV is 1024; stay under it per sendmsg call.
-_IOV_MAX = 512
-_HAS_SENDMSG = hasattr(socket.socket, "sendmsg")
-
-
-def _as_bytes_view(b) -> memoryview:
-    """Byte-typed memoryview of any buffer without copying. ndarrays go
-    through a uint8 reinterpret (extension dtypes like ml_dtypes bfloat16
-    reject the buffer protocol directly)."""
-    if isinstance(b, np.ndarray):
-        a = np.ascontiguousarray(b)
-        return memoryview(a.reshape(-1).view(np.uint8))
-    return memoryview(b).cast("B")
-
-
-def _iov_nbytes(bufs: Sequence) -> int:
-    return sum(
-        b.nbytes if isinstance(b, np.ndarray) else len(b) for b in bufs
-    )
-
-
-def _iov_join(bufs: Sequence) -> bytes:
-    """Materialize an iovec list (tests / lossy-codec self-decode only —
-    never on the send path)."""
-    return b"".join(bytes(_as_bytes_view(b)) for b in bufs)
-
-
-def _sendmsg_all(sock: socket.socket, bufs: Sequence) -> None:
-    """sendall semantics over an iovec list: every buffer hits the wire,
-    in order, with no concatenation into an intermediate payload."""
-    mvs = [mv for mv in (_as_bytes_view(b) for b in bufs) if len(mv)]
-    if not _HAS_SENDMSG:  # pragma: no cover — non-Linux fallback
-        sock.sendall(b"".join(mvs))
-        return
-    while mvs:
-        sent = sock.sendmsg(mvs[:_IOV_MAX])
-        if sent == 0:
-            raise ConnectionError("comm transport connection closed")
-        while sent and mvs:
-            if sent >= len(mvs[0]):
-                sent -= len(mvs[0])
-                mvs.pop(0)
-            else:
-                mvs[0] = mvs[0][sent:]
-                sent = 0
+# The byte-plane primitives (iovec sends, exact receives, uint8
+# reinterpret views) live in comm/wire.py, SHARED with the heal plane —
+# one implementation for both data paths. The private aliases above keep
+# this module's historical names for its own call sites and tests.
 
 
 def _duplex_exchange(tx_sock: socket.socket, tx_bufs: Sequence,
@@ -268,24 +238,6 @@ def _duplex_exchange(tx_sock: socket.socket, tx_bufs: Sequence,
     finally:
         for s in socks:
             s.settimeout(timeout)
-
-
-def _recv_into_exact(sock: socket.socket, mv: memoryview) -> None:
-    got, n = 0, len(mv)
-    while got < n:
-        r = sock.recv_into(mv[got:], min(n - got, 1 << 20))
-        if r == 0:
-            raise ConnectionError("comm transport connection closed")
-        got += r
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytearray:
-    """One-shot exact receive into a fresh right-sized buffer (rendezvous
-    handshakes); lanes use the pooled :class:`_RecvBufs` instead."""
-    buf = bytearray(n)
-    if n:
-        _recv_into_exact(sock, memoryview(buf))
-    return buf
 
 
 class _RecvBufs:
@@ -686,10 +638,6 @@ _CODECS = {
 _NO_CODEC = _NoCodec()
 
 
-def _bf16_dtype():
-    import ml_dtypes
-
-    return np.dtype(ml_dtypes.bfloat16)
 
 
 class _Lane:

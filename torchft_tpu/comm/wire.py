@@ -1,0 +1,160 @@
+"""Shared byte-plane helpers for BOTH data planes.
+
+The gradient transport (comm/transport.py) and the heal plane
+(checkpointing.py) move the same thing — large contiguous tensor bytes —
+over sockets, and PRs 1-3 grew a zero-copy toolkit for the gradient side:
+uint8 reinterpret views (extension dtypes reject the buffer protocol
+directly), scatter-gather ``sendmsg`` with sendall semantics, and
+``recv_into`` loops that land bytes straight into their final buffers.
+This module is that toolkit factored out so the heal plane reuses ONE
+implementation instead of growing a parallel copy (the shared-helper
+boundary documented in docs/architecture.md).
+
+Everything here is numpy + stdlib only (no jax import), so transport
+tools and tests can run in jax-less environments.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "IOV_MAX",
+    "HAS_SENDMSG",
+    "as_bytes_view",
+    "iov_nbytes",
+    "iov_join",
+    "sendmsg_all",
+    "recv_into_exact",
+    "recv_exact",
+    "readinto_exact",
+    "tensor_wire_view",
+    "bf16_wire_dtype",
+    "split_stripes",
+]
+
+# Linux UIO_MAXIOV is 1024; stay under it per sendmsg call.
+IOV_MAX = 512
+HAS_SENDMSG = hasattr(socket.socket, "sendmsg")
+
+
+def as_bytes_view(b) -> memoryview:
+    """Byte-typed memoryview of any buffer without copying. ndarrays go
+    through a uint8 reinterpret (extension dtypes like ml_dtypes bfloat16
+    reject the buffer protocol's format codes)."""
+    if isinstance(b, np.ndarray):
+        a = np.ascontiguousarray(b)
+        return memoryview(a.reshape(-1).view(np.uint8))
+    return memoryview(b).cast("B")
+
+
+def tensor_wire_view(arr: np.ndarray) -> "Tuple[memoryview, int]":
+    """``(byte view of arr, full-array copies performed)``.
+
+    The heal plane's copy-accounting variant of :func:`as_bytes_view`:
+    a C-contiguous array of any registered dtype (ml_dtypes included)
+    yields a zero-copy uint8 reinterpret view and count 0; a
+    non-contiguous array costs exactly one ``ascontiguousarray`` copy;
+    an array whose memory layout refuses even the uint8 view (exotic
+    strides/dtype combinations) falls back to ``tobytes``. The count
+    feeds the donor's zero-copy test hook."""
+    copies = 0
+    if not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
+        copies += 1
+    try:
+        return memoryview(arr.reshape(-1).view(np.uint8)), copies
+    except (TypeError, ValueError):  # pragma: no cover — exotic layouts
+        return memoryview(arr.tobytes()), copies + 1
+
+
+def iov_nbytes(bufs: Sequence) -> int:
+    return sum(
+        b.nbytes if isinstance(b, np.ndarray) else len(b) for b in bufs
+    )
+
+
+def iov_join(bufs: Sequence) -> bytes:
+    """Materialize an iovec list (tests / lossy-codec self-decode only —
+    never on the send path)."""
+    return b"".join(bytes(as_bytes_view(b)) for b in bufs)
+
+
+def sendmsg_all(sock: socket.socket, bufs: Sequence) -> None:
+    """sendall semantics over an iovec list: every buffer hits the wire,
+    in order, with no concatenation into an intermediate payload."""
+    mvs = [mv for mv in (as_bytes_view(b) for b in bufs) if len(mv)]
+    if not HAS_SENDMSG:  # pragma: no cover — non-Linux fallback
+        sock.sendall(b"".join(mvs))
+        return
+    while mvs:
+        sent = sock.sendmsg(mvs[:IOV_MAX])
+        if sent == 0:
+            raise ConnectionError("comm transport connection closed")
+        while sent and mvs:
+            if sent >= len(mvs[0]):
+                sent -= len(mvs[0])
+                mvs.pop(0)
+            else:
+                mvs[0] = mvs[0][sent:]
+                sent = 0
+
+
+def recv_into_exact(sock: socket.socket, mv: memoryview) -> None:
+    got, n = 0, len(mv)
+    while got < n:
+        r = sock.recv_into(mv[got:], min(n - got, 1 << 20))
+        if r == 0:
+            raise ConnectionError("comm transport connection closed")
+        got += r
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytearray:
+    """One-shot exact receive into a fresh right-sized buffer (rendezvous
+    handshakes); hot paths use pooled buffers instead."""
+    buf = bytearray(n)
+    if n:
+        recv_into_exact(sock, memoryview(buf))
+    return buf
+
+
+def readinto_exact(fp, mv: memoryview, what: str = "body") -> None:
+    """Fill ``mv`` exactly from a file-like object exposing ``readinto``
+    (an HTTP response body). Raises a prescriptive ``ConnectionError`` on
+    a short body instead of letting a downstream reshape crash."""
+    got, n = 0, len(mv)
+    while got < n:
+        r = fp.readinto(mv[got:])
+        if not r:
+            raise ConnectionError(
+                f"{what} truncated at {got}/{n} bytes — the sender died "
+                "mid-stream or advertised a wrong length; refetch from a "
+                "live peer"
+            )
+        got += r
+
+
+def bf16_wire_dtype() -> np.dtype:
+    """The bfloat16 wire dtype (ml_dtypes-backed; numpy alone cannot
+    resolve it). Shared by the gradient codecs and the heal plane's
+    opt-in ``heal_wire_dtype`` path."""
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def split_stripes(n: int, stripe_count: int) -> "List[Tuple[int, int]]":
+    """Deterministic 1-D stripe grid over ``n`` rows: ``stripe_count``
+    contiguous (start, stop) ranges, balanced to within one row, empty
+    ranges dropped. Both healer planning and tests compute the identical
+    grid — the same shapes-only determinism contract as the gradient
+    transport's chunk grid."""
+    stripe_count = max(1, min(stripe_count, n))
+    return [
+        (n * k // stripe_count, n * (k + 1) // stripe_count)
+        for k in range(stripe_count)
+        if n * (k + 1) // stripe_count > n * k // stripe_count
+    ]

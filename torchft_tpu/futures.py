@@ -31,6 +31,7 @@ __all__ = [
     "future_chain",
     "future_all",
     "FutureGroup",
+    "StealableTask",
     "completed_future",
     "failed_future",
     "TimerHandle",
@@ -272,6 +273,56 @@ class FutureGroup:
             self._out.set_result(self._fn())  # type: ignore[misc]
         except Exception as e:  # noqa: BLE001
             _try_set_exception(self._out, e)
+
+
+class StealableTask:
+    """A deferred computation exactly one thread may execute, with any
+    number of waiters.
+
+    This is the lazy-staging heal plane's priority-bump primitive: the
+    donor's background stager walks leaf tasks in order calling
+    :meth:`run`, while an HTTP handler thread that needs leaf *i* NOW
+    calls :meth:`result` on that leaf directly — whichever side claims
+    the task first executes it inline, the other just observes
+    ``future``. No queue reshuffling, no executor priorities: the bump
+    is the requester stealing the work onto its own thread.
+
+    The callable is dropped after execution so a task whose closure
+    pins large buffers (a staged device array) releases them once the
+    result exists.
+    """
+
+    def __init__(self, fn: "Callable[[], T]") -> None:
+        self._fn: "Optional[Callable[[], T]]" = fn
+        self._lock = threading.Lock()
+        self._claimed = False
+        self.future: "Future[T]" = Future()
+        self.future.set_running_or_notify_cancel()
+
+    def run(self) -> None:
+        """Execute the task if unclaimed (no-op otherwise); resolves
+        ``future`` either way (immediately, or by the claiming thread
+        when it finishes)."""
+        with self._lock:
+            if self._claimed:
+                return
+            self._claimed = True
+            fn = self._fn
+            self._fn = None
+        try:
+            self.future.set_result(fn())  # type: ignore[misc]
+        except BaseException as e:  # noqa: BLE001 — deliver to waiters
+            _try_set_exception(self.future, e)  # type: ignore[arg-type]
+
+    @property
+    def done(self) -> bool:
+        return self.future.done()
+
+    def result(self, timeout: Optional[float] = None) -> T:
+        """Priority path: claim-and-run inline when still pending, else
+        wait for the thread that already claimed it."""
+        self.run()
+        return self.future.result(timeout)
 
 
 def completed_future(value: T) -> "Future[T]":

@@ -150,7 +150,13 @@ class Manager:
         self._world_size = world_size
 
         if checkpoint_transport is None:
-            checkpoint_transport = CheckpointServer(timeout=self._timeout)
+            # num_chunks=2: the default heal rides the raw-bytes
+            # streaming plane (readinto + keep-alive, no pickle for
+            # tensor data) — the legacy full-stream pickle path remains
+            # reachable by passing an explicit CheckpointServer.
+            checkpoint_transport = CheckpointServer(
+                timeout=self._timeout, num_chunks=2
+            )
         self._checkpoint_transport = checkpoint_transport
 
         self._executor = ThreadPoolExecutor(
@@ -250,6 +256,17 @@ class Manager:
         set_metrics = getattr(comm, "set_metrics", None)
         if callable(set_metrics):
             set_metrics(self.metrics)
+        # Same deal for the heal plane: its stage/wire/H2D spans
+        # (heal_stage / heal_wire / heal_h2d) and the heal_bytes_per_s /
+        # heal_wall_ms gauges land in this sink too.
+        ckpt_set_metrics = getattr(
+            self._checkpoint_transport, "set_metrics", None
+        )
+        if callable(ckpt_set_metrics):
+            ckpt_set_metrics(self.metrics)
+        # wall-clock anchor for the CURRENT heal: set when the quorum
+        # assigns us a heal, cleared when the healed state is applied
+        self._heal_t0: Optional[float] = None
 
     # ------------------------------------------------------------- lifecycle
 
@@ -623,7 +640,10 @@ class Manager:
                 )
             if quorum.heal:
                 try:
+                    import time as _time
+
                     self._healing = True
+                    self._heal_t0 = _time.perf_counter()
                     self._logger.info(
                         f"healing required, fetching checkpoint metadata "
                         f"from {quorum.recover_src_manager_address} "
@@ -679,6 +699,17 @@ class Manager:
         self._load_state_dict(self._pending_state_dict["user"])
         self._pending_state_dict = None
         self._did_heal = True
+        if self._heal_t0 is not None:
+            # heal assignment → healed-state ready, end to end: quorum
+            # answer, donor fetch (stage/wire/H2D spans are inside), and
+            # the user load_state_dict that just ran
+            import time as _time
+
+            self.metrics.gauge(
+                "heal_wall_ms",
+                (_time.perf_counter() - self._heal_t0) * 1000.0,
+            )
+            self._heal_t0 = None
         self._logger.info("loaded state dict")
 
     # ---------------------------------------------------------------- commit

@@ -24,6 +24,7 @@ class Metrics:
     def __init__(self, window: int = 128) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = defaultdict(float)
+        self._gauges: Dict[str, float] = {}
         self._timings: Dict[str, Deque[float]] = defaultdict(
             lambda: deque(maxlen=window)
         )
@@ -31,6 +32,14 @@ class Metrics:
     def incr(self, name: str, value: float = 1.0) -> None:
         with self._lock:
             self._counters[name] += value
+
+    def gauge(self, name: str, value: float) -> None:
+        """Set an absolute last-write-wins value (e.g. the most recent
+        heal's ``heal_wall_ms`` / ``heal_bytes_per_s``). Gauges land in
+        ``snapshot`` under their bare name, like counters — callers keep
+        the namespaces disjoint."""
+        with self._lock:
+            self._gauges[name] = float(value)
 
     def observe(self, name: str, seconds: float) -> None:
         with self._lock:
@@ -67,6 +76,7 @@ class Metrics:
         out: Dict[str, float] = {}
         with self._lock:
             out.update(self._counters)
+            out.update(self._gauges)
             for name, window in self._timings.items():
                 if window:
                     vals = sorted(window)

@@ -22,7 +22,9 @@ import time
 from contextlib import contextmanager
 from typing import Optional
 
-__all__ = ["StepProfiler", "trace", "host_span", "timed_span"]
+__all__ = [
+    "StepProfiler", "trace", "host_span", "timed_span", "throughput_span",
+]
 
 
 @contextmanager
@@ -73,6 +75,28 @@ def timed_span(metrics, name: str, span: Optional[str] = None):
     finally:
         if metrics is not None:
             metrics.observe(name, time.perf_counter() - start)
+
+
+@contextmanager
+def throughput_span(metrics, name: str, nbytes: "int | list"):
+    """``timed_span`` + a derived ``{name}_bytes_per_s`` gauge.
+
+    The heal plane wraps its wire phase in this so the same block feeds
+    the profiler timeline, the ``{name}`` timing window, AND a
+    bandwidth gauge the bench artifacts report directly. ``nbytes`` may
+    be a mutable single-element list when the byte count is only known
+    at exit (a fetch whose manifest arrives inside the span)."""
+    start = time.perf_counter()
+    try:
+        with host_span(name):
+            yield
+    finally:
+        elapsed = time.perf_counter() - start
+        if metrics is not None:
+            metrics.observe(name, elapsed)
+            n = nbytes[0] if isinstance(nbytes, list) else nbytes
+            if n and elapsed > 0:
+                metrics.gauge(f"{name}_bytes_per_s", n / elapsed)
 
 
 class StepProfiler:
