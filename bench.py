@@ -92,6 +92,14 @@ def _sync_algorithms_phase() -> dict:
     cfg = CONFIGS[model_name]
     batch = int(os.environ.get("BENCH_SYNC_BATCH", "2"))
     seq_len = min(int(os.environ.get("BENCH_SYNC_SEQ", "64")), cfg.max_seq_len)
+    # Streaming outer-sync knobs (the fragment scheduler's A/B levers):
+    # BENCH_FRAGMENTS fragments per round (clamped to sync_every),
+    # BENCH_OUTER_CODEC the wire codec the outer plane rides
+    # (none/bf16/int8 — EF engages automatically where compensable),
+    # BENCH_STREAMING=0 pins the blocking arm.
+    fragments = int(os.environ.get("BENCH_FRAGMENTS", "2"))
+    outer_codec = os.environ.get("BENCH_OUTER_CODEC", "none")
+    outer_streaming = os.environ.get("BENCH_STREAMING", "1") != "0"
 
     class _FaultyComm(TcpCommContext):
         """Transport whose Nth allreduce raises — a real injected fault
@@ -140,6 +148,7 @@ def _sync_algorithms_phase() -> dict:
         syncs_attempted = [0]
         syncs_committed = [0]
         errors: list = []
+        outer_snap: dict = {}  # group 0's outer_* gauges at teardown
 
         def replica(gid: int) -> None:
             store = StoreServer()
@@ -161,6 +170,7 @@ def _sync_algorithms_phase() -> dict:
             comm = _FaultyComm(
                 fail_at=(fault_at_sync if gid == 0 else None),
                 timeout=8.0,
+                compression=outer_codec,
             )
             manager = Manager(
                 comm=comm,
@@ -178,10 +188,12 @@ def _sync_algorithms_phase() -> dict:
                 replica_id=f"{algorithm}_{gid}_",
                 heartbeat_interval=0.1,
             )
+            n_frag = max(1, min(fragments, sync_every))
             if algorithm == "local_sgd":
                 wrapper = LocalSGD(
                     manager, sync_every=sync_every,
                     params_fn=lambda: holder["params"],
+                    num_fragments=n_frag, streaming=outer_streaming,
                 )
             else:
                 wrapper = DiLoCo(
@@ -189,6 +201,7 @@ def _sync_algorithms_phase() -> dict:
                     optax.sgd(0.5, momentum=0.9, nesterov=True),
                     sync_every=sync_every,
                     params_fn=lambda: holder["params"],
+                    num_fragments=n_frag, streaming=outer_streaming,
                 )
             wrapper_ref["w"] = wrapper
             holder["params"] = wrapper.register(holder["params"])
@@ -243,6 +256,13 @@ def _sync_algorithms_phase() -> dict:
                     errors.append(f"group {gid}:\n{traceback.format_exc()}")
                 stop.set()
             finally:
+                if gid == 0:
+                    with lock:
+                        outer_snap.update({
+                            k: v
+                            for k, v in manager.metrics.snapshot().items()
+                            if k.startswith("outer_")
+                        })
                 manager.shutdown(wait=False)
                 store.shutdown()
 
@@ -293,6 +313,18 @@ def _sync_algorithms_phase() -> dict:
             "inner_steps_per_sec": round(steps_total / elapsed, 2),
             "consistent": consistent,
             "window_s": round(elapsed, 1),
+            # Streaming outer-sync surface (group 0's gauges): overlap =
+            # 1 - exposed/total outer wire time, the bench's
+            # t1_outer_overlap headline.
+            "fragments": max(1, min(fragments, sync_every)),
+            "streaming": outer_streaming,
+            "outer_codec": outer_codec,
+            "outer_wire_ms": outer_snap.get("outer_wire_ms"),
+            "outer_wire_exposed_ms": outer_snap.get(
+                "outer_wire_exposed_ms"
+            ),
+            "outer_overlap": outer_snap.get("outer_overlap"),
+            "outer_wire_bytes": outer_snap.get("outer_wire_bytes"),
         }
         if fault_at_sync is not None:
             # recovery = the fault's sync was discarded AND committed
@@ -1826,6 +1858,17 @@ def _run() -> None:
     else:
         classic_overhead = None
 
+    # Streaming outer-sync headline gauges, sourced from the sync phase
+    # (the outer plane only exists there — the main T1 window is
+    # DDP-shaped): overlap = 1 - exposed/total outer wire time. None
+    # when the sync phase was skipped or failed.
+    def _outer_gauge(key):
+        for phase_name in ("diloco", "localsgd"):
+            r = sync_results.get(phase_name)
+            if isinstance(r, dict) and r.get(key) is not None:
+                return r[key]
+        return None
+
     flops_step = _flops_per_step(cfg, n_params, seq_len, tokens_per_step)
     if peak_flops is not None:
         mfu = flops_step * steps / t1_elapsed / peak_flops
@@ -1855,6 +1898,8 @@ def _run() -> None:
             "t1_pipeline_ms": t1_pipeline_ms,
             "t1_pipeline_overlap": t1_pipeline_overlap,
             "t1_ddp_streamed": _bench_ddp_streamed(),
+            "t1_outer_overlap": _outer_gauge("outer_overlap"),
+            "t1_outer_wire_ms": _outer_gauge("outer_wire_ms"),
             "t1_lane_ms": t1_lane_ms,
             "t1_lane_balance": t1_lane_balance,
             "t1_fused_steps": t1_fused,

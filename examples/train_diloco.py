@@ -3,7 +3,12 @@ outer-optimizer DP over a transformer; LocalSGD via ALGO=local_sgd).
 
 Inner steps run locally at full speed; every SYNC_EVERY steps the groups
 average pseudogradients (DiLoCo) or weights (LocalSGD) through the
-manager, with commit/rollback semantics. Requires sync quorum (DiLoCo).
+manager, with commit/rollback semantics. The outer sync rides the
+streaming fragment scheduler: NUM_FRAGMENTS (default 2) byte-balanced
+fragments stagger across the round and overlap the wire with inner
+compute; STREAMING=0 pins the blocking arm. DiLoCo no longer requires
+sync quorum (the round-start fence handles async-quorum heals) — this
+example keeps use_async_quorum=False for eager per-round heals.
 
     python -m torchft_tpu.lighthouse_cli --min_replicas 2 &
     REPLICA_GROUP_ID=0 NUM_REPLICA_GROUPS=2 \
@@ -39,6 +44,10 @@ def main() -> None:
     num_groups = int(os.environ.get("NUM_REPLICA_GROUPS", "2"))
     total_syncs = int(os.environ.get("TOTAL_SYNCS", "10"))
     sync_every = int(os.environ.get("SYNC_EVERY", "8"))
+    num_fragments = max(1, min(
+        int(os.environ.get("NUM_FRAGMENTS", "2")), sync_every
+    ))
+    streaming = os.environ.get("STREAMING", "1") != "0"
     algo = os.environ.get("ALGO", "diloco")
     if algo not in ("diloco", "local_sgd"):
         raise ValueError(f"ALGO must be diloco or local_sgd, got {algo!r}")
@@ -77,7 +86,8 @@ def main() -> None:
         load_state_dict=load_state_dict,
         state_dict=state_dict,
         min_replica_size=1,
-        use_async_quorum=False,  # required by DiLoCo
+        use_async_quorum=False,  # optional since the round-start fence;
+        # sync mode keeps heals eager at every quorum
         # the quorum window must cover sync_every inner steps
         quorum_timeout=600.0,
         rank=0,
@@ -91,11 +101,13 @@ def main() -> None:
         wrapper = DiLoCo(
             manager, outer_tx, sync_every=sync_every,
             params_fn=lambda: holder["params"],
+            num_fragments=num_fragments, streaming=streaming,
         )
     else:
         wrapper = LocalSGD(
             manager, sync_every=sync_every,
             params_fn=lambda: holder["params"],
+            num_fragments=num_fragments, streaming=streaming,
         )
     wrapper_ref["w"] = wrapper
     holder["params"] = wrapper.register(holder["params"])

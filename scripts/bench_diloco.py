@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Rep-interleaved blocking-vs-streaming DiLoCo outer-sync A/B.
+
+Two replica groups (threads, one real TcpCommContext each — the wire is
+real loopback TCP; the control plane is stubbed so the measurement is
+the OUTER SYNC, not quorum RPCs) train a synthetic param tree with a
+fixed jitted compute burn per inner step, and sync through the streaming
+fragment scheduler. Each rep runs BOTH arms back-to-back with the arm
+order alternating between reps (rep-interleaved: background drift hits
+both arms equally), from identical initial state with identical
+pregenerated inner updates — so the two arms' committed params must be
+BITWISE identical per round (the oracle; verified every rep), and the
+wall-clock delta is pure scheduling.
+
+Headline numbers per arm: total wall time, per-round exposed outer wire
+time (what the inner loop actually stalled on), the outer_overlap gauge
+(1 - exposed/total wire time; > 0 with >= 2 fragments means the wire is
+riding behind inner compute), and outer_wire_bytes (codec compression
+evidence).
+
+Knobs: BENCH_DILOCO_REPS (4), BENCH_DILOCO_ROUNDS (3),
+BENCH_DILOCO_SYNC (8), BENCH_FRAGMENTS (4), BENCH_OUTER_CODEC (none),
+BENCH_DILOCO_MB (8), BENCH_DILOCO_BURN (256 — matmul dim of the inner
+compute burn), BENCH_DILOCO_WORLD (2).
+
+Prints one JSON line last. Committed runs live under docs/evidence/.
+"""
+
+import gc
+import hashlib
+import json
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from torchft_tpu.comm import StoreServer, TcpCommContext  # noqa: E402
+from torchft_tpu.local_sgd import DiLoCo  # noqa: E402
+from torchft_tpu.utils.wire_stub import WireStubManager  # noqa: E402
+
+# Shared with tests/test_localsgd_streaming.py and bench_smoke.py so
+# every harness drives the identical manager surface.
+_WireStubManager = WireStubManager
+
+
+def _params0(total_mb: float, leaves: int = 16):
+    """Synthetic f32 tree: `leaves` uneven leaves totaling ~total_mb."""
+    rng = np.random.default_rng(11)
+    total_elems = int(total_mb * (1 << 20) / 4)
+    weights = rng.integers(1, 8, leaves).astype(np.float64)
+    weights /= weights.sum()
+    out = {}
+    for i, w in enumerate(weights):
+        n = max(64, int(total_elems * w))
+        out[f"w{i:02d}"] = jnp.asarray(
+            rng.standard_normal(n).astype(np.float32)
+        )
+    return out
+
+
+def _increments(rank: int, steps: int, shapes):
+    rng = np.random.default_rng(5000 + rank)
+    return [
+        {k: jnp.asarray(
+            (rng.standard_normal(s) * 1e-3).astype(np.float32))
+         for k, s in shapes.items()}
+        for _ in range(steps)
+    ]
+
+
+def run_arm(store_addr, prefix, streaming, cfg):
+    world = cfg["world"]
+    ctxs = [
+        TcpCommContext(timeout=60.0, algorithm="star", channels=4,
+                       compression=cfg["codec"])
+        for _ in range(world)
+    ]
+    results = [None] * world
+    steps = cfg["rounds"] * cfg["sync_every"]
+    burn_dim = cfg["burn"]
+
+    @jax.jit
+    def _burn(x):
+        for _ in range(2):
+            x = jnp.tanh(x @ x) * 0.5 + x * 0.5
+        return x
+
+    def _worker(rank):
+        ctx = ctxs[rank]
+        ctx.configure(f"{store_addr}/{prefix}", rank, world)
+        manager = _WireStubManager(ctx, world)
+        wrapper = DiLoCo(
+            manager, optax.sgd(0.7, momentum=0.9, nesterov=True),
+            sync_every=cfg["sync_every"],
+            num_fragments=cfg["fragments"], streaming=streaming,
+        )
+        params = wrapper.register(_params0(cfg["mb"]))
+        shapes = {k: np.shape(v) for k, v in params.items()}
+        incs = _increments(rank, steps, shapes)
+        burn_x = jnp.asarray(
+            np.random.default_rng(rank).standard_normal(
+                (burn_dim, burn_dim)
+            ).astype(np.float32)
+        )
+        burn_x = jax.block_until_ready(_burn(burn_x))  # warm the jit
+        digest = hashlib.sha256()
+        t0 = time.perf_counter()
+        for t in range(steps):
+            burn_x = jax.block_until_ready(_burn(burn_x))  # inner compute
+            params = {k: params[k] + incs[t][k] for k in params}
+            params = wrapper.step(params)
+            if wrapper.local_step == 0:  # a round just committed
+                for k in sorted(params):
+                    digest.update(np.asarray(params[k]).tobytes())
+        wall = time.perf_counter() - t0
+        snap = {
+            k: v for k, v in manager.metrics.snapshot().items()
+            if k.startswith("outer_")
+        }
+        results[rank] = {
+            "wall_s": wall, "digest": digest.hexdigest(), "metrics": snap,
+        }
+
+    with ThreadPoolExecutor(max_workers=world) as pool:
+        for f in [pool.submit(_worker, r) for r in range(world)]:
+            f.result(timeout=600)
+    for ctx in ctxs:
+        ctx.shutdown()
+
+    m0 = results[0]["metrics"]
+    return {
+        "streaming": streaming,
+        "wall_s": round(results[0]["wall_s"], 3),
+        "outer_wire_ms": m0.get("outer_wire_ms"),
+        "outer_wire_exposed_ms": m0.get("outer_wire_exposed_ms"),
+        "outer_overlap": m0.get("outer_overlap"),
+        "outer_wire_bytes": m0.get("outer_wire_bytes"),
+        "outer_inflight_at_drain": m0.get("outer_inflight_at_drain"),
+        "digests": [r["digest"] for r in results],
+    }
+
+
+def main() -> int:
+    cfg = {
+        "world": int(os.environ.get("BENCH_DILOCO_WORLD", "2")),
+        "rounds": int(os.environ.get("BENCH_DILOCO_ROUNDS", "3")),
+        "sync_every": int(os.environ.get("BENCH_DILOCO_SYNC", "8")),
+        "fragments": int(os.environ.get("BENCH_FRAGMENTS", "4")),
+        "codec": os.environ.get("BENCH_OUTER_CODEC", "none"),
+        "mb": float(os.environ.get("BENCH_DILOCO_MB", "8")),
+        "burn": int(os.environ.get("BENCH_DILOCO_BURN", "256")),
+    }
+    reps = int(os.environ.get("BENCH_DILOCO_REPS", "4"))
+    store = StoreServer()
+    runs = []
+    bitwise_ok = True
+    try:
+        # one unmeasured warmup pair (rendezvous, jit, allocator)
+        run_arm(store.addr, "warm_b", False, cfg)
+        run_arm(store.addr, "warm_s", True, cfg)
+        for rep in range(reps):
+            order = [False, True] if rep % 2 == 0 else [True, False]
+            rep_out = {"rep": rep}
+            gc.collect()
+            for streaming in order:
+                arm = run_arm(
+                    store.addr,
+                    f"rep{rep}_{'s' if streaming else 'b'}",
+                    streaming, cfg,
+                )
+                rep_out["streaming" if streaming else "blocking"] = arm
+                gc.collect()
+            # bitwise oracle: identical committed trajectories across
+            # arms AND across ranks
+            s, b = rep_out["streaming"], rep_out["blocking"]
+            rep_ok = (
+                len(set(s["digests"])) == 1
+                and len(set(b["digests"])) == 1
+                and s["digests"][0] == b["digests"][0]
+            )
+            rep_out["bitwise_identical"] = rep_ok
+            bitwise_ok = bitwise_ok and rep_ok
+            runs.append(rep_out)
+            sys.stderr.write(
+                f"bench_diloco rep {rep}: blocking {b['wall_s']}s "
+                f"(exposed {b['outer_wire_exposed_ms']}ms) vs streaming "
+                f"{s['wall_s']}s (exposed {s['outer_wire_exposed_ms']}ms, "
+                f"overlap {s['outer_overlap']}) bitwise={rep_ok}\n"
+            )
+    finally:
+        store.shutdown()
+
+    def _med(vals):
+        vals = sorted(v for v in vals if v is not None)
+        return vals[len(vals) // 2] if vals else None
+
+    summary = {
+        "metric": "diloco_outer_sync_ab",
+        "config": cfg,
+        "reps": reps,
+        "bitwise_identical": bitwise_ok,
+        "blocking_wall_s_med": _med(
+            [r["blocking"]["wall_s"] for r in runs]
+        ),
+        "streaming_wall_s_med": _med(
+            [r["streaming"]["wall_s"] for r in runs]
+        ),
+        "blocking_exposed_ms_med": _med(
+            [r["blocking"]["outer_wire_exposed_ms"] for r in runs]
+        ),
+        "streaming_exposed_ms_med": _med(
+            [r["streaming"]["outer_wire_exposed_ms"] for r in runs]
+        ),
+        "streaming_overlap_med": _med(
+            [r["streaming"]["outer_overlap"] for r in runs]
+        ),
+        "blocking_overlap_med": _med(
+            [r["blocking"]["outer_overlap"] for r in runs]
+        ),
+        "runs": runs,
+    }
+    print(json.dumps(summary))
+    return 0 if bitwise_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,9 @@
 #!/usr/bin/env python
 """Bench metric-surface smoke: run bench.py one short window and assert
-the streamed-pipeline gauges are present and finite.
+the streamed-pipeline gauges are present and finite; also run one tiny
+in-process heal round (heal_* gauges) and one short streaming-DiLoCo
+round (outer_* gauges — outer_wire_ms / outer_overlap — plus the
+t1_outer_overlap payload key).
 
 Driven by ``BENCH_SMOKE=1 scripts/test.sh``. The point is that a metric
 regression (a renamed key, a gauge that silently stopped being computed,
@@ -80,6 +83,82 @@ def heal_smoke() -> "list[str]":
     return failures
 
 
+def diloco_smoke() -> "list[str]":
+    """One short streaming-DiLoCo round over a real 2-rank loopback
+    transport; returns failure strings if the outer-sync metric surface
+    (outer_wire_ms / outer_overlap + stage timers) is missing or
+    non-finite. Runs the REAL fragment scheduler: staggered boundaries,
+    non-blocking wire, staged landings, round commit."""
+    import math
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+    import optax
+
+    import jax.numpy as jnp
+    from torchft_tpu.comm import StoreServer, TcpCommContext
+    from torchft_tpu.local_sgd import DiLoCo
+    # The shared round-surface stub (also drives
+    # tests/test_localsgd_streaming.py and scripts/bench_diloco.py).
+    from torchft_tpu.utils.wire_stub import WireStubManager as _Stub
+
+    failures = []
+    world, sync_every, fragments = 2, 4, 2
+    store = StoreServer()
+    ctxs = [TcpCommContext(timeout=30.0, algorithm="star", channels=2)
+            for _ in range(world)]
+    snaps = [None] * world
+    committed = [None] * world
+
+    def _worker(rank):
+        ctx = ctxs[rank]
+        ctx.configure(f"{store.addr}/diloco_smoke", rank, world)
+        manager = _Stub(ctx, world)
+        wrapper = DiLoCo(manager, optax.sgd(0.7), sync_every=sync_every,
+                         num_fragments=fragments, streaming=True)
+        rng = np.random.default_rng(0)  # identical init on every rank
+        params = wrapper.register({
+            "w": jnp.asarray(
+                rng.standard_normal(1 << 14).astype(np.float32)
+            ),
+            "b": jnp.asarray(
+                rng.standard_normal(1 << 12).astype(np.float32)
+            ),
+        })
+        for t in range(sync_every):
+            # rank-dependent inner movement: the average is the thing
+            # being synced, the starting point must agree
+            scale = np.float32(0.99 - 0.01 * rank)
+            params = {k: params[k] * scale for k in params}
+            params = wrapper.step(params)
+        committed[rank] = {
+            k: np.asarray(v).tobytes() for k, v in params.items()
+        }
+        snaps[rank] = manager.metrics.snapshot()
+
+    try:
+        with ThreadPoolExecutor(max_workers=world) as pool:
+            for f in [pool.submit(_worker, r) for r in range(world)]:
+                f.result(timeout=120)
+    finally:
+        for ctx in ctxs:
+            ctx.shutdown()
+        store.shutdown()
+
+    if committed[0] != committed[1]:
+        failures.append("diloco smoke: ranks committed divergent rounds")
+    snap = snaps[0] or {}
+    for key in ("outer_wire_ms", "outer_overlap", "outer_wire_bytes",
+                "outer_d2h_avg_ms", "outer_wire_avg_ms",
+                "outer_land_avg_ms"):
+        v = snap.get(key)
+        if v is None or not math.isfinite(float(v)) or v < 0:
+            failures.append(
+                f"diloco smoke: gauge {key!r} missing/non-finite: {v!r}"
+            )
+    return failures
+
+
 def main() -> int:
     env = {
         k: v for k, v in os.environ.items()
@@ -121,8 +200,9 @@ def main() -> int:
         return 1
 
     failures = heal_smoke()
+    failures += diloco_smoke()
     for key in ("t1_pipeline_overlap", "t1_pipeline_ms", "t1_ddp_streamed",
-                "t1_overhead_ms"):
+                "t1_overhead_ms", "t1_outer_overlap", "t1_outer_wire_ms"):
         if key not in payload:
             failures.append(f"missing key {key!r}")
     classic = payload.get("t1_classic_steps") or 0
@@ -156,7 +236,7 @@ def main() -> int:
         f"overlap={payload['t1_pipeline_overlap']} "
         f"classic_steps={classic} "
         f"stages={sorted(payload['t1_pipeline_ms'])} "
-        "heal_gauges=ok"
+        "heal_gauges=ok outer_gauges=ok"
     )
     return 0
 

@@ -6,10 +6,12 @@
 #   scripts/test.sh              # tier-1 gate (non-slow tests, CPU devices)
 #   FULL=1 scripts/test.sh       # native build + entire suite (slow included)
 #   BENCH_SMOKE=1 scripts/test.sh  # one short bench.py window + one tiny
-#                                  # heal round; asserts the streamed-pipeline
-#                                  # AND heal_* gauges are present and finite
-#                                  # (metric regressions fail loudly instead
-#                                  # of vanishing from the artifact)
+#                                  # heal round + one streaming-DiLoCo round;
+#                                  # asserts the streamed-pipeline, heal_* AND
+#                                  # outer_* (t1_outer_overlap/outer_wire_ms)
+#                                  # gauges are present and finite (metric
+#                                  # regressions fail loudly instead of
+#                                  # vanishing from the artifact)
 
 set -u
 cd "$(dirname "$0")/.."

@@ -1,0 +1,512 @@
+"""Streaming fragment scheduler for LocalSGD/DiLoCo: bitwise identity vs
+the blocking arm, fragment partitioning, mid-round abort rollback,
+heal-at-fence re-read, and the outer metric surface
+(docs/architecture.md "Outer sync pipeline").
+
+The load-bearing invariant mirrors the DDP pipeline's: streaming is a
+pure SCHEDULING change — same fragment grid, same snapshot points, same
+codec/EF math, same per-lane submission order — so a streaming round's
+committed params must be bitwise identical to the blocking arm's for
+every codec × topology at the same fragment grid, with the EF residuals
+evolving across rounds in both arms."""
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from torchft_tpu.comm import ReduceOp, StoreServer, TcpCommContext
+from torchft_tpu.comm.context import CompletedWork, Work
+from torchft_tpu.comm.wire import split_weighted
+from torchft_tpu.local_sgd import DiLoCo, LocalSGD, fragment_boundaries
+from torchft_tpu.utils.metrics import Metrics
+from torchft_tpu.utils.wire_stub import WireStubManager
+
+
+@pytest.fixture()
+def store():
+    server = StoreServer()
+    yield server
+    server.shutdown()
+
+
+# Manager facade over a raw TcpCommContext — shared with the bench
+# harnesses so every driver exercises the identical manager surface.
+_WireStubManager = WireStubManager
+
+
+class _LocalStubManager:
+    """Transport-less stub: identity averaging with manager-style error
+    latching (a failed op LATCHES and its future resolves to the inputs,
+    exactly the wrap_future contract) plus a heal-at-fence hook."""
+
+    def __init__(self, fail_at_op=None) -> None:
+        self.metrics = Metrics()
+        self._use_async_quorum = True
+        self._error = None
+        self._ops = 0
+        self.fail_at_op = fail_at_op
+        self.heal_next_fence = False
+        self._did_heal = False
+
+    def start_quorum(self, **kw) -> None:
+        self._error = None
+        self._did_heal = False
+
+    def quorum_fence(self) -> None:
+        if self.heal_next_fence:
+            self._did_heal = True
+            self.heal_next_fence = False
+
+    def did_heal(self) -> bool:
+        return self._did_heal
+
+    def errored(self):
+        return self._error
+
+    def report_error(self, e) -> None:
+        if self._error is None:
+            self._error = e
+
+    def should_commit(self) -> bool:
+        return self._error is None
+
+    def is_participating(self) -> bool:
+        return True
+
+    def wire_compensable(self) -> bool:
+        return False
+
+    def wire_is_lossy(self) -> bool:
+        return False
+
+    def wire_generation(self) -> int:
+        return 0
+
+    def wire_roundtrip(self, src, out) -> None:
+        np.copyto(out, src)
+
+    def wire_nbytes(self, a) -> int:
+        return int(np.asarray(a).nbytes)
+
+    def allreduce_arrays(self, arrays, op=ReduceOp.SUM) -> Work:
+        self._ops += 1
+        if self._error is not None:
+            return CompletedWork([np.asarray(a) for a in arrays])
+        if self.fail_at_op is not None and self._ops == self.fail_at_op:
+            self.report_error(RuntimeError("injected outer-sync fault"))
+            return CompletedWork([np.asarray(a) for a in arrays])
+        return CompletedWork([np.array(a, copy=True) for a in arrays])
+
+
+def _params0():
+    """Multi-leaf f32 tree with uneven leaf sizes so the byte-balanced
+    fragment grid actually splits mid-tree."""
+    rng = np.random.default_rng(7)
+    return {
+        "a": jnp.asarray(rng.standard_normal(96).astype(np.float32)),
+        "b": jnp.asarray(rng.standard_normal((8, 8)).astype(np.float32)),
+        "c": jnp.asarray(rng.standard_normal(160).astype(np.float32)),
+        "d": jnp.asarray(rng.standard_normal(32).astype(np.float32)),
+        "e": jnp.asarray(rng.standard_normal(48).astype(np.float32)),
+    }
+
+
+def _increments(rank: int, steps: int):
+    """Deterministic per-(rank, step) inner updates, pre-generated so
+    every arm replays the identical inner trajectory."""
+    rng = np.random.default_rng(1000 + rank)
+    base = _params0()
+    return [
+        {k: jnp.asarray(
+            (rng.standard_normal(np.shape(v)) * 0.1).astype(np.float32))
+         for k, v in base.items()}
+        for _ in range(steps)
+    ]
+
+
+def _run_arm(store, prefix, algorithm, world, codec, fragments,
+             streaming, rounds=2, sync_every=4, outer_tx=None):
+    """Run `rounds` sync rounds through a real transport world; returns
+    the per-round committed params (host copies) for every rank."""
+    ctxs = [
+        TcpCommContext(timeout=15.0, algorithm=algorithm, channels=2,
+                       compression=codec, chunk_bytes=256)
+        for _ in range(world)
+    ]
+    outs = [None] * world
+    steps = rounds * sync_every
+
+    def _worker(rank):
+        ctx = ctxs[rank]
+        ctx.configure(f"{store.addr}/{prefix}", rank, world)
+        manager = _WireStubManager(ctx, world)
+        if outer_tx is not None:
+            wrapper = DiLoCo(
+                manager, outer_tx(), sync_every=sync_every,
+                num_fragments=fragments, streaming=streaming,
+            )
+        else:
+            wrapper = LocalSGD(
+                manager, sync_every=sync_every,
+                num_fragments=fragments, streaming=streaming,
+            )
+        params = wrapper.register(_params0())
+        incs = _increments(rank, steps)
+        per_round = []
+        for t in range(steps):
+            params = {k: params[k] + incs[t][k] for k in params}
+            params = wrapper.step(params)
+            if wrapper.local_step == 0:  # a round just committed
+                per_round.append(
+                    {k: np.asarray(params[k]).copy() for k in sorted(params)}
+                )
+        outs[rank] = per_round
+
+    with ThreadPoolExecutor(max_workers=world) as pool:
+        for f in [pool.submit(_worker, r) for r in range(world)]:
+            f.result(timeout=120)
+    for ctx in ctxs:
+        ctx.shutdown()
+    return outs
+
+
+@pytest.mark.parametrize("algorithm,world", [("star", 2), ("ring", 3)])
+@pytest.mark.parametrize("codec", ["none", "bf16", "int8"])
+def test_streaming_bitwise_identical_to_blocking(
+    store, algorithm, world, codec
+) -> None:
+    # EF "auto" engages exactly where it should (star peers under a
+    # lossy codec) and the identity must hold with the residual arenas
+    # evolving across rounds in both arms, at every fragment grid.
+    outer = lambda: optax.sgd(0.7, momentum=0.9, nesterov=True)  # noqa: E731
+    for fragments in (1, 2, 4):
+        tag = f"{algorithm}_{codec}_f{fragments}"
+        streamed = _run_arm(store, f"st_{tag}", algorithm, world, codec,
+                            fragments, streaming=True, outer_tx=outer)
+        blocking = _run_arm(store, f"bl_{tag}", algorithm, world, codec,
+                            fragments, streaming=False, outer_tx=outer)
+        for rank in range(world):
+            assert len(streamed[rank]) == len(blocking[rank]) == 2
+            for t, (got, ref) in enumerate(
+                zip(streamed[rank], blocking[rank])
+            ):
+                for key in ref:
+                    assert got[key].tobytes() == ref[key].tobytes(), (
+                        f"{tag}: streaming diverged from blocking at "
+                        f"round {t}, rank {rank}, leaf {key!r}"
+                    )
+        # cross-rank identity within the streamed run (trajectory
+        # consistency — every rank must commit the same round state)
+        for rank in range(1, world):
+            for t in range(len(streamed[0])):
+                for key in streamed[0][t]:
+                    assert (
+                        streamed[rank][t][key].tobytes()
+                        == streamed[0][t][key].tobytes()
+                    ), f"{tag}: rank {rank} diverged at round {t}"
+
+
+def test_streaming_localsgd_bitwise_and_ef_disabled(store) -> None:
+    # LocalSGD (weight averaging) arm identity, with error_feedback
+    # implicitly raw for the int8 wire on the root and active on peers —
+    # plus the EF-off code path in a second config.
+    for fragments in (2, 4):
+        streamed = _run_arm(store, f"ls_st_{fragments}", "star", 2,
+                            "int8", fragments, streaming=True)
+        blocking = _run_arm(store, f"ls_bl_{fragments}", "star", 2,
+                            "int8", fragments, streaming=False)
+        for rank in range(2):
+            for got, ref in zip(streamed[rank], blocking[rank]):
+                for key in ref:
+                    assert got[key].tobytes() == ref[key].tobytes()
+
+
+# ------------------------------------------------------ fragment grid
+
+
+def test_fragment_partition_deterministic_balanced() -> None:
+    sizes = [96 * 4, 64 * 4, 160 * 4, 32 * 4, 48 * 4]
+    grid = split_weighted(sizes, 3)
+    # exact cover, contiguous, non-empty
+    assert grid[0][0] == 0 and grid[-1][1] == len(sizes)
+    for (a, b), (c, d) in zip(grid, grid[1:]):
+        assert b == c and b > a and d > c
+    # deterministic
+    assert grid == split_weighted(sizes, 3)
+    # balanced to within the largest leaf
+    weights = [sum(sizes[a:b]) for a, b in grid]
+    assert max(weights) - min(weights) <= max(sizes)
+    # clamps to the item count
+    assert split_weighted([8, 8], 5) == [(0, 1), (1, 2)]
+    assert split_weighted([8], 1) == [(0, 1)]
+
+
+def test_fragment_boundaries_schedule() -> None:
+    assert fragment_boundaries(8, 4) == [2, 4, 6, 8]
+    assert fragment_boundaries(8, 1) == [8]
+    assert fragment_boundaries(4, 4) == [1, 2, 3, 4]
+    assert fragment_boundaries(5, 2) == [2, 5]
+    # strictly increasing whenever sync_every >= num_fragments
+    for e in range(1, 12):
+        for f in range(1, e + 1):
+            bs = fragment_boundaries(e, f)
+            assert bs[-1] == e and all(
+                b2 > b1 for b1, b2 in zip(bs, bs[1:])
+            )
+
+
+# ------------------------------------------------- abort / heal paths
+
+
+def test_midround_abort_rolls_back_every_fragment() -> None:
+    # Fragment 0 lands successfully, fragment 1's op latches: the WHOLE
+    # round must roll back — including the fragment that landed — and
+    # the next round (fresh quorum clears the latch) must commit.
+    manager = _LocalStubManager(fail_at_op=2)
+    diloco = DiLoCo(manager, optax.sgd(1.0), sync_every=4,
+                    num_fragments=4, streaming=True)
+    p0 = _params0()
+    params = diloco.register(p0)
+    ref = {k: np.asarray(v).copy() for k, v in p0.items()}
+    for t in range(4):
+        params = {k: params[k] + 1.0 for k in params}
+        params = diloco.step(params)
+    assert diloco.local_step == 0
+    for k in ref:  # every fragment restored to the registered backup
+        assert np.asarray(params[k]).tobytes() == ref[k].tobytes(), k
+    # next round commits. Fragment staleness is part of the schedule:
+    # fragment f ships at inner step f+1 (boundaries [1,2,3,4]), when
+    # the inner loop has added (f+1) to its leaves — outer sgd lr=1
+    # adopts exactly that per-fragment snapshot.
+    manager.fail_at_op = None
+    for t in range(4):
+        params = {k: params[k] + 1.0 for k in params}
+        params = diloco.step(params)
+    keys = sorted(ref)
+    for f, (start, stop) in enumerate(diloco._fragments):
+        for i in range(start, stop):
+            k = keys[i]
+            np.testing.assert_allclose(
+                np.asarray(params[k]), ref[k] + (f + 1.0), rtol=1e-6,
+                err_msg=f"fragment {f} leaf {k!r}",
+            )
+
+
+def test_heal_at_fence_rereads_params_fn() -> None:
+    # A heal applied at the round-start fence: the round's snapshots
+    # must derive from the params_fn re-read, and without a donor backup
+    # the healed state becomes the new sync point.
+    healed = {k: v * 0.0 + 5.0 for k, v in _params0().items()}
+    holder = {"params": _params0()}
+    manager = _LocalStubManager()
+    wrapper = LocalSGD(manager, sync_every=2, num_fragments=2,
+                       streaming=True,
+                       params_fn=lambda: holder["params"])
+    params = wrapper.register(holder["params"])
+    manager.heal_next_fence = True
+    holder["params"] = healed
+    # no inner movement: isolates the heal re-read (fragment staleness
+    # would otherwise shift later fragments by the inner updates)
+    for t in range(2):
+        params = wrapper.step(params)
+    # identity averaging of the healed state -> committed params == healed
+    for k in healed:
+        np.testing.assert_allclose(np.asarray(params[k]),
+                                   np.asarray(healed[k]), rtol=1e-6)
+    # and the backup was re-saved from the healed state
+    for k, v in wrapper.restore().items():
+        np.testing.assert_allclose(np.asarray(v),
+                                   np.asarray(healed[k]), rtol=1e-6)
+
+
+def test_heal_keeps_donor_backup_as_sync_point() -> None:
+    # When the donor's backup arrived through load_state_dict, the fence
+    # must keep IT (the true sync point), not re-save the healed params:
+    # with outer lr=0.5 the committed round is the midpoint between the
+    # donor backup and the healed params — distinguishable from both.
+    base = _params0()
+    donor_backup = {k: v * 0.0 + 2.0 for k, v in base.items()}
+    healed = {k: v * 0.0 + 6.0 for k, v in base.items()}
+    holder = {"params": base}
+    manager = _LocalStubManager()
+    wrapper = DiLoCo(manager, optax.sgd(0.5), sync_every=2,
+                     num_fragments=2, streaming=True,
+                     params_fn=lambda: holder["params"])
+    params = wrapper.register(base)
+    wrapper.load_state_dict({
+        "backup": donor_backup, "local_step": 0,
+        "outer_state": wrapper.outer_state,
+    })
+    manager.heal_next_fence = True
+    holder["params"] = healed
+    for t in range(2):  # no inner movement (see test above)
+        params = wrapper.step(params)
+    # pseudograd = donor(2) - healed(6) = -4; sgd lr=0.5 -> 2 + 2 = 4
+    for k in base:
+        np.testing.assert_allclose(
+            np.asarray(params[k]), np.full(base[k].shape, 4.0), rtol=1e-6
+        )
+
+
+# -------------------------------------------------------- metric surface
+
+
+def test_outer_metric_surface() -> None:
+    manager = _LocalStubManager()
+    wrapper = DiLoCo(manager, optax.sgd(0.7), sync_every=2,
+                     num_fragments=2, streaming=True)
+    params = wrapper.register(_params0())
+    for t in range(2):
+        params = {k: params[k] + 1.0 for k in params}
+        params = wrapper.step(params)
+    snap = manager.metrics.snapshot()
+    for stage in ("outer_d2h", "outer_wire", "outer_land"):
+        assert f"{stage}_avg_ms" in snap, (stage, sorted(snap))
+        assert np.isfinite(snap[f"{stage}_avg_ms"])
+    for gauge in ("outer_wire_ms", "outer_wire_exposed_ms",
+                  "outer_overlap", "outer_wire_bytes",
+                  "outer_inflight_at_drain"):
+        assert gauge in snap, (gauge, sorted(snap))
+        assert np.isfinite(snap[gauge]) and snap[gauge] >= 0.0
+    assert 0.0 <= snap["outer_overlap"] <= 1.0
+    # f32 identity wire: payload bytes == 4 * total elements
+    total_elems = sum(
+        int(np.prod(np.shape(v))) for v in _params0().values()
+    )
+    assert snap["outer_wire_bytes"] == 4 * total_elems
+
+
+def test_streaming_overlaps_wire_behind_inner_steps() -> None:
+    # Overlap mechanics with a DELAYED wire: fragment 0 (shipped at step
+    # 1 of 2) must resolve while the inner loop is still stepping, so
+    # the exposed time at the drain is less than the summed wire time
+    # and the overlap gauge reads > 0 with >= 2 fragments.
+    delay = 0.15
+
+    class _DelayedStub(_LocalStubManager):
+        def allreduce_arrays(self, arrays, op=ReduceOp.SUM):
+            self._ops += 1
+            fut: Future = Future()
+            fut.set_running_or_notify_cancel()
+            arrs = [np.array(a, copy=True) for a in arrays]
+
+            def _complete():
+                time.sleep(delay)
+                fut.set_result(arrs)
+
+            threading.Thread(target=_complete, daemon=True).start()
+            return Work(fut)
+
+    manager = _DelayedStub()
+    wrapper = LocalSGD(manager, sync_every=2, num_fragments=2,
+                       streaming=True)
+    params = wrapper.register(_params0())
+    for t in range(2):
+        params = {k: params[k] + 1.0 for k in params}
+        params = wrapper.step(params)
+        if t == 0:
+            time.sleep(delay * 1.5)  # inner compute hiding fragment 0
+    snap = manager.metrics.snapshot()
+    assert snap["outer_overlap"] > 0.25, snap
+    assert snap["outer_wire_exposed_ms"] < snap["outer_wire_ms"], snap
+
+
+def test_sync_quorum_heal_does_not_rewind_round() -> None:
+    # A use_async_quorum=False manager applies the heal INSIDE
+    # start_quorum — while the wrapper's round object does not exist
+    # yet. The donor's mid-round local_step must NOT be adopted there:
+    # the schedule owns the counter, and a rewind would leave this
+    # round's fragments unshipped while every peer blocks in its
+    # allreduce waiting for them.
+    refs = {}
+
+    class _SyncQuorumStub(_LocalStubManager):
+        def __init__(self) -> None:
+            super().__init__()
+            self._use_async_quorum = False
+            self.heal_in_start_quorum = False
+
+        def start_quorum(self, **kw) -> None:
+            super().start_quorum(**kw)
+            if self.heal_in_start_quorum:
+                self.heal_in_start_quorum = False
+                refs["wrapper"].load_state_dict(
+                    {"backup": refs["donor_backup"], "local_step": 1}
+                )
+                self._did_heal = True
+
+    base = _params0()
+    healed = {k: v * 0.0 + 3.0 for k, v in base.items()}
+    holder = {"params": base}
+    manager = _SyncQuorumStub()
+    wrapper = LocalSGD(manager, sync_every=4, num_fragments=1,
+                       streaming=True,
+                       params_fn=lambda: holder["params"])
+    refs["wrapper"] = wrapper
+    refs["donor_backup"] = {k: v * 0.0 + 2.0 for k, v in base.items()}
+    params = wrapper.register(base)
+    for t in range(3):
+        params = wrapper.step(params)
+    manager.heal_in_start_quorum = True
+    holder["params"] = healed
+    params = wrapper.step(params)  # the round-start step (boundary 4)
+    assert wrapper.local_step == 0, (
+        "heal rewound the fragment schedule; the round never closed"
+    )
+    # identity averaging of the healed state committed this round
+    for k in healed:
+        np.testing.assert_allclose(
+            np.asarray(params[k]), np.asarray(healed[k]), rtol=1e-6
+        )
+
+
+def test_sync_without_register() -> None:
+    # Catch-up parity with the pre-streaming API: sync() on a wrapper
+    # that never saw register()/step() must bootstrap the layout (and
+    # DiLoCo's per-fragment outer state) instead of crashing.
+    manager = _LocalStubManager()
+    wrapper = DiLoCo(manager, optax.sgd(1.0), sync_every=4,
+                     num_fragments=2, streaming=True)
+    base = _params0()
+    params = wrapper.sync(base)
+    assert wrapper.local_step == 0
+    # backup seeded from the same params -> pseudogradient is exactly 0
+    for k, v in base.items():
+        np.testing.assert_allclose(
+            np.asarray(params[k]), np.asarray(v), rtol=1e-6
+        )
+
+
+def test_load_state_dict_leaf_count_mismatch_raises() -> None:
+    # A donor backup whose leaf count disagrees with the frozen layout
+    # must be a loud error, not a zip()-truncated partial apply.
+    wrapper = LocalSGD(_LocalStubManager(), sync_every=2,
+                       num_fragments=2, streaming=True)
+    wrapper.register(_params0())
+    with pytest.raises(ValueError, match="leaves"):
+        wrapper.load_state_dict(
+            {"backup": {"a": np.zeros(96, np.float32)}, "local_step": 0}
+        )
+
+
+def test_outer_pools_are_split() -> None:
+    # The DDP rule, mirrored: EF quantizer tasks and fragment landings
+    # must never share a pool, or an in-flight quantizer delays a
+    # landing whose wire future already resolved.
+    from torchft_tpu.local_sgd import _outer_executor
+
+    assert _outer_executor("ef") is not _outer_executor("land")
+
+
+def test_num_fragments_validation() -> None:
+    with pytest.raises(ValueError, match="num_fragments must be >= 1"):
+        LocalSGD(_LocalStubManager(), sync_every=4, num_fragments=0)
+    with pytest.raises(ValueError, match="must be >= num_fragments"):
+        LocalSGD(_LocalStubManager(), sync_every=3, num_fragments=4)

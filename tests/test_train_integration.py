@@ -33,6 +33,13 @@ def mock_manager(commit=True, use_async=True, local_vote=True):
     m._use_async_quorum = use_async
     m.num_participants.return_value = 1
     m.is_solo_wire.return_value = False  # exercise the real transport path
+    m.errored.return_value = None
+    m.did_heal.return_value = False
+    # identity wire: no EF arena (a bare MagicMock would return a truthy
+    # mock from wire_compensable and engage error feedback against a
+    # no-op wire_roundtrip, corrupting every multi-sync test)
+    m.wire_compensable.return_value = False
+    m.wire_is_lossy.return_value = False
     # identity allreduce: average over 1 participant
     m.allreduce_arrays.side_effect = lambda arrays, **kw: CompletedWork(
         [np.array(a, copy=True) for a in arrays]
@@ -248,8 +255,12 @@ def test_local_sgd_sync_cadence() -> None:
     manager = mock_manager(commit=True)
     local = LocalSGD(manager, sync_every=2)
     params = local.register({"w": jnp.zeros(2)})
-    params = local.step({"w": jnp.ones(2)})      # step 1: no sync
-    manager.start_quorum.assert_not_called()
+    params = local.step({"w": jnp.ones(2)})      # step 1: quorum kicked
+    # Async-quorum managers kick the round's quorum one step AHEAD of
+    # the first fragment boundary so the RPC overlaps inner compute;
+    # the sync itself (fence + ship + commit) still runs at step 2.
+    manager.start_quorum.assert_called_once()
+    manager.should_commit.assert_not_called()
     params = local.step({"w": jnp.full(2, 2.0)})  # step 2: sync
     manager.start_quorum.assert_called_once()
     manager.should_commit.assert_called_once()
@@ -278,10 +289,28 @@ def test_local_sgd_commit_updates_backup() -> None:
 # --------------------------------------------------------------------- DiLoCo
 
 
-def test_diloco_requires_sync_quorum() -> None:
-    manager = mock_manager(use_async=True)
-    with pytest.raises(ValueError, match="synchronous quorum"):
-        DiLoCo(manager, optax.sgd(0.7), sync_every=2)
+def test_diloco_accepts_async_quorum() -> None:
+    # The old hard ValueError is replaced by the round-start quorum
+    # fence: async-quorum managers are fenced (quorum resolved + pending
+    # heal applied eagerly) at the first fragment boundary instead of
+    # being rejected outright.
+    manager = mock_manager(commit=True, use_async=True)
+    diloco = DiLoCo(manager, optax.sgd(1.0), sync_every=2)
+    diloco.register({"w": jnp.zeros(2, dtype=jnp.float32)})
+    diloco.step({"w": jnp.full(2, 1.0, dtype=jnp.float32)})
+    params = diloco.step({"w": jnp.full(2, 3.0, dtype=jnp.float32)})
+    manager.quorum_fence.assert_called_once()
+    np.testing.assert_allclose(params["w"], np.full(2, 3.0), rtol=1e-6)
+
+
+def test_sync_every_must_cover_fragments() -> None:
+    # Prescriptive error: fragments ship at distinct inner-step
+    # boundaries, so the round must have at least num_fragments steps.
+    with pytest.raises(ValueError, match="num_fragments"):
+        LocalSGD(mock_manager(), sync_every=2, num_fragments=4)
+    with pytest.raises(ValueError, match="num_fragments"):
+        DiLoCo(mock_manager(use_async=False), optax.sgd(0.7),
+               sync_every=3, num_fragments=5)
 
 
 def test_diloco_outer_step_applies_pseudogradient() -> None:

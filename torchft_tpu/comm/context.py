@@ -180,6 +180,13 @@ class CommContext(ABC):
         Identity wire: a plain copy."""
         np.copyto(out, src)
 
+    def wire_nbytes(self, a: np.ndarray) -> int:
+        """Encoded payload size of ``a`` as ONE allreduce contribution
+        (codec applied per grid chunk) — what one direction of the wire
+        actually carries, for bandwidth/compression-ratio gauges.
+        Identity wire: the raw byte count."""
+        return int(np.asarray(a).nbytes)
+
 
 class DummyCommContext(CommContext):
     """World-size-1 context that completes every op with its own inputs —
@@ -292,6 +299,9 @@ class ErrorSwallowingCommContext(CommContext):
     def wire_roundtrip(self, src: np.ndarray, out: np.ndarray) -> None:
         self._inner.wire_roundtrip(src, out)
 
+    def wire_nbytes(self, a: np.ndarray) -> int:
+        return self._inner.wire_nbytes(a)
+
 
 class ManagedCommContext(CommContext):
     """Context that routes every collective through a Manager so errors and
@@ -343,3 +353,6 @@ class ManagedCommContext(CommContext):
 
     def wire_roundtrip(self, src: np.ndarray, out: np.ndarray) -> None:
         self._manager.wire_roundtrip(src, out)
+
+    def wire_nbytes(self, a: np.ndarray) -> int:
+        return self._manager.wire_nbytes(a)

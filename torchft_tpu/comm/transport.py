@@ -1513,6 +1513,17 @@ class TcpCommContext(CommContext):
                 _iov_join(codec.encode_iovecs([ch_s])), [ch_o], copy
             )
 
+    def wire_nbytes(self, a: np.ndarray) -> int:
+        """Encoded payload size of ``a`` as one allreduce contribution:
+        the codec's per-chunk wire size summed over the same chunk grid
+        a real op would use (int8 carries a per-chunk scale header, so
+        the grid matters). Pure size arithmetic — nothing is encoded."""
+        a = np.asarray(a)
+        return sum(
+            self._codec.wire_nbytes(ch)
+            for ch in _chunk_grid([a.reshape(-1)], self._chunk_bytes)
+        )
+
     # ----------------------------------------------------------- collectives
 
     @staticmethod

@@ -34,6 +34,7 @@ __all__ = [
     "tensor_wire_view",
     "bf16_wire_dtype",
     "split_stripes",
+    "split_weighted",
 ]
 
 # Linux UIO_MAXIOV is 1024; stay under it per sendmsg call.
@@ -158,3 +159,39 @@ def split_stripes(n: int, stripe_count: int) -> "List[Tuple[int, int]]":
         for k in range(stripe_count)
         if n * (k + 1) // stripe_count > n * k // stripe_count
     ]
+
+
+def split_weighted(
+    weights: "Sequence[int]", part_count: int
+) -> "List[Tuple[int, int]]":
+    """Deterministic weighted partition: contiguous (start, stop) ranges
+    over ``len(weights)`` items, balanced by cumulative weight instead of
+    item count — :func:`split_stripes` for items of unequal size. Every
+    range is non-empty (``part_count`` is clamped to the item count), and
+    the grid is a pure function of the weights, so all ranks compute the
+    identical partition from shapes alone — the same determinism contract
+    the chunk/stripe grids rely on. The outer-sync fragment scheduler
+    (torchft_tpu/local_sgd.py) uses this to byte-balance param-tree
+    leaves across fragments."""
+    n = len(weights)
+    part_count = max(1, min(part_count, n))
+    total = sum(int(w) for w in weights)
+    out: "List[Tuple[int, int]]" = []
+    start = 0
+    acc = 0
+    for i in range(n):
+        acc += int(weights[i])
+        closed = len(out)
+        parts_left = part_count - closed
+        items_left = n - (i + 1)
+        if parts_left == 1:
+            continue  # the final range swallows the tail
+        # Close once this range reaches its even share of the total
+        # weight, or when the remaining items are only just enough to
+        # give every remaining range one item.
+        if (acc * part_count >= total * (closed + 1)
+                or items_left == parts_left - 1):
+            out.append((start, i + 1))
+            start = i + 1
+    out.append((start, n))
+    return out

@@ -241,6 +241,16 @@ class FutureGroup:
             self._pending += 1
         fut.add_done_callback(self._member_done)
 
+    @property
+    def outstanding(self) -> int:
+        """Members registered but not yet settled. Observability for
+        streamed producers: the outer-sync scheduler reads this right
+        before its round-end drain to report how many fragments were
+        still riding the wire when the round ran out of inner steps to
+        hide them behind (the overlap evidence)."""
+        with self._lock:
+            return self._pending
+
     def _member_done(self, f: Future) -> None:
         exc = f.exception()
         with self._lock:

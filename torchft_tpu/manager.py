@@ -245,7 +245,11 @@ class Manager:
         # via set_metrics below), and the DDP wrapper's per-bucket stage
         # timers (ddp_d2h / ddp_ef / ddp_wire / ddp_h2d plus the
         # ddp_wire_total / ddp_wire_exposed overlap gauges — the DDP
-        # layer reads this sink through ``manager.metrics``). One
+        # layer reads this sink through ``manager.metrics``), and the
+        # outer-sync fragment scheduler's stage timers (outer_d2h /
+        # outer_ef / outer_wire / outer_land plus the per-round
+        # outer_wire_ms / outer_wire_exposed_ms / outer_overlap /
+        # outer_wire_bytes gauges the bench grades). One
         # snapshot therefore tells the whole story of where a step's
         # wall time went, and one reset_timings() bounds a measurement
         # window for every layer at once (bench.py relies on this).
@@ -486,6 +490,29 @@ class Manager:
             "must call start_quorum before wait_quorum"
         )
         self._quorum_future.result()
+
+    def quorum_fence(self) -> None:
+        """Round-start fence for fragment-scheduled sync wrappers
+        (LocalSGD/DiLoCo streaming rounds, torchft_tpu/local_sgd.py).
+
+        Blocks on the in-flight quorum AND eagerly applies a pending heal
+        — the async-quorum analog of ``use_async_quorum=False``'s eager
+        heal, paid once per sync ROUND instead of forcing the whole job
+        onto synchronous quorum. A round's fragment snapshots (and the
+        backup they diff against) must all derive from the healed state,
+        so the heal cannot wait for should_commit the way the per-step
+        DDP flow allows: the first fragment ships ``sync_every/F`` inner
+        steps before the commit barrier runs. After this returns,
+        ``did_heal()`` tells the wrapper to re-read params.
+
+        With ``use_async_quorum=False`` the heal already happened inside
+        start_quorum and this degrades to a plain wait. Raises whatever
+        the quorum raised — callers latch via report_error so the round
+        aborts at its commit barrier instead of crashing mid-loop."""
+        self.wait_quorum()
+        if self._healing:
+            self._apply_pending_state_dict()
+            self._healing = False
 
     def _async_quorum(
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
@@ -872,6 +899,16 @@ class Manager:
             fn(src, out)
         else:
             np.copyto(out, src)
+
+    def wire_nbytes(self, a: np.ndarray) -> int:
+        """Encoded one-direction payload size of ``a`` under the current
+        wire codec/chunk grid (raw nbytes for identity wires) — the
+        outer-sync scheduler's ``outer_wire_bytes`` gauge and the bench's
+        compression-ratio evidence read the wire through this."""
+        fn = getattr(self._comm, "wire_nbytes", None)
+        if callable(fn):
+            return int(fn(a))
+        return int(np.asarray(a).nbytes)
 
     def transport_world_size(self) -> int:
         """Members of the gradient wire for the current quorum (data-plane

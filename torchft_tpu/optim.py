@@ -20,9 +20,66 @@ recompile anything.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
-__all__ = ["OptimizerWrapper"]
+__all__ = ["OptimizerWrapper", "PartitionedOuterOptimizer"]
+
+
+class PartitionedOuterOptimizer:
+    """A per-fragment partition of one optax transformation.
+
+    The streaming outer sync (torchft_tpu/local_sgd.py) lands each
+    fragment's outer update the moment that fragment's averaged
+    pseudogradient comes off the wire — while later fragments are still
+    riding it — so the outer state must be addressable PER FRAGMENT, not
+    as one monolithic tree. Each fragment owns an independent optax
+    state over its leaf list; for the elementwise transformations outer
+    optimizers use in practice (sgd, momentum/nesterov, adam...) the
+    concatenation of per-fragment updates is exactly the monolithic
+    update, fragment count merely re-slices the state.
+
+    Commit discipline: :meth:`update_fragment` is PURE — it returns the
+    staged (new_params, new_state) pair without mutating anything, and
+    the round adopts states via :meth:`adopt` only after the commit
+    barrier votes yes, so an aborted round leaves every fragment's outer
+    state untouched (the rollback invariant). ``adopt`` replaces the
+    state list rather than mutating it, so a snapshot taken before a
+    sync (``states``) is never silently updated under the caller."""
+
+    def __init__(self, tx) -> None:
+        self._tx = tx
+        self._states: "Optional[List[Any]]" = None
+
+    def init(self, fragments: "Sequence[Sequence[Any]]") -> None:
+        """One optax state per fragment, over that fragment's leaf list."""
+        self._states = [self._tx.init(list(f)) for f in fragments]
+
+    @property
+    def states(self) -> "Optional[List[Any]]":
+        return self._states
+
+    def load_states(self, states: "Sequence[Any]") -> None:
+        self._states = list(states)
+
+    def update_fragment(
+        self, f: int, grads: "Sequence[Any]", params: "Sequence[Any]"
+    ) -> "Tuple[List[Any], Any]":
+        """Staged outer step for fragment ``f``: returns
+        ``(new_params_leaves, new_state)`` WITHOUT adopting the state —
+        the round adopts on commit, discards on abort."""
+        import optax
+
+        assert self._states is not None, "init() was never called"
+        updates, new_state = self._tx.update(
+            list(grads), self._states[f], list(params)
+        )
+        return list(optax.apply_updates(list(params), updates)), new_state
+
+    def adopt(self, f: int, new_state: Any) -> None:
+        assert self._states is not None, "init() was never called"
+        states = list(self._states)
+        states[f] = new_state
+        self._states = states
 
 
 class OptimizerWrapper:

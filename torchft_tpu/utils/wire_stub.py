@@ -1,0 +1,91 @@
+"""Manager facade over a raw CommContext for single-process harnesses.
+
+tests/test_localsgd_streaming.py, scripts/bench_diloco.py and
+scripts/bench_smoke.py all drive the LocalSGD/DiLoCo round machinery
+over a real loopback transport without a control plane. The wrapper
+probes the manager surface via ``getattr`` (``wire_compensable``,
+``quorum_fence``, ``wire_nbytes``, ...), so a drifted hand-rolled copy
+would silently exercise the getattr-fallback path instead of the real
+one — one shared stub keeps every harness on the same surface.
+
+Semantics: quorum/fence/heal are no-ops, AVG scaling divides float
+payloads by the wire world, and ``should_commit`` mirrors the real
+manager's error-latch vote (a reported error aborts the round).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+
+import numpy as np
+
+from torchft_tpu.comm.context import ReduceOp, Work
+from torchft_tpu.futures import future_chain
+from torchft_tpu.utils.metrics import Metrics
+
+__all__ = ["WireStubManager"]
+
+
+class WireStubManager:
+    def __init__(self, ctx, world: int) -> None:
+        self._ctx = ctx
+        self._world = world
+        self.metrics = Metrics()
+        self._use_async_quorum = True
+        self._error = None
+
+    def start_quorum(self, **kw) -> None:
+        self._error = None
+
+    def quorum_fence(self) -> None:
+        pass
+
+    def wait_quorum(self) -> None:
+        pass
+
+    def did_heal(self) -> bool:
+        return False
+
+    def errored(self):
+        return self._error
+
+    def report_error(self, e) -> None:
+        if self._error is None:
+            self._error = e
+
+    def should_commit(self) -> bool:
+        return self._error is None
+
+    def is_participating(self) -> bool:
+        return True
+
+    def num_participants(self) -> int:
+        return self._world
+
+    def wire_is_lossy(self) -> bool:
+        return self._ctx.wire_is_lossy()
+
+    def wire_compensable(self) -> bool:
+        return self._ctx.wire_compensable()
+
+    def wire_generation(self) -> int:
+        return self._ctx.wire_generation()
+
+    def wire_roundtrip(self, src, out) -> None:
+        self._ctx.wire_roundtrip(src, out)
+
+    def wire_nbytes(self, a) -> int:
+        return self._ctx.wire_nbytes(a)
+
+    def allreduce_arrays(self, arrays, op=ReduceOp.SUM) -> Work:
+        work = self._ctx.allreduce(list(arrays), ReduceOp.SUM)
+        scale = np.float32(1.0 / self._world)
+
+        def _avg(f: Future):
+            reduced = f.result()
+            for a in reduced:
+                if a.dtype in (np.float32, np.float64):
+                    np.multiply(a, a.dtype.type(scale), out=a)
+            return reduced
+
+        return Work(future_chain(work.future(), _avg))
