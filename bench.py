@@ -262,6 +262,7 @@ def _sync_algorithms_phase() -> dict:
                             k: v
                             for k, v in manager.metrics.snapshot().items()
                             if k.startswith("outer_")
+                            or k == "comm_backend"
                         })
                 manager.shutdown(wait=False)
                 store.shutdown()
@@ -319,6 +320,10 @@ def _sync_algorithms_phase() -> dict:
             "fragments": max(1, min(fragments, sync_every)),
             "streaming": outer_streaming,
             "outer_codec": outer_codec,
+            # Which data plane the outer_* gauges rode — the label the
+            # group's metrics sink carries (host sockets today; "xla"
+            # when the on-device backend drives the outer plane).
+            "comm_backend": outer_snap.get("comm_backend", "host"),
             "outer_wire_ms": outer_snap.get("outer_wire_ms"),
             "outer_wire_exposed_ms": outer_snap.get(
                 "outer_wire_exposed_ms"
@@ -542,6 +547,7 @@ def _classic_overhead_phase(t0_step_ms=None) -> dict:
         out = {
             "steps": n,
             "reps": reps,
+            "comm_backend": manager.comm_backend(),
             "bare_s": round(bare_best, 4),
             "ft_s": round(ft_best, 4),
             "overhead_ms_per_step": (
@@ -1643,6 +1649,12 @@ def _run() -> None:
         if k in _m
     }
     _PARTIAL["t1_overhead_ms"] = t1_overhead
+    # The data plane every comm_*/ddp_* gauge above rode ("host" sockets
+    # or "xla" on-device collectives) — the manager's metrics label, so
+    # host-vs-xla bench artifacts are distinguishable by inspection.
+    _PARTIAL["comm_backend"] = _m.get(
+        "comm_backend", manager.comm_backend()
+    )
     # Step-pipeline stage breakdown (per-bucket d2h/ef/wire/h2d wall
     # times recorded by the DDP wrapper into the manager's sink) and the
     # overlap gauge: t1_pipeline_overlap = 1 - exposed/total, where
@@ -1894,6 +1906,7 @@ def _run() -> None:
                 None if flash_err != flash_err else flash_err
             ),
             "commit_rate": t1_commit_rate,
+            "comm_backend": _PARTIAL["comm_backend"],
             "t1_overhead_ms": t1_overhead,
             "t1_pipeline_ms": t1_pipeline_ms,
             "t1_pipeline_overlap": t1_pipeline_overlap,

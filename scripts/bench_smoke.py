@@ -1,9 +1,11 @@
 #!/usr/bin/env python
 """Bench metric-surface smoke: run bench.py one short window and assert
 the streamed-pipeline gauges are present and finite; also run one tiny
-in-process heal round (heal_* gauges) and one short streaming-DiLoCo
+in-process heal round (heal_* gauges), one short streaming-DiLoCo
 round (outer_* gauges — outer_wire_ms / outer_overlap — plus the
-t1_outer_overlap payload key).
+t1_outer_overlap payload key), and one xla-backend allreduce round
+under a forced host device count (backend-tagged comm_* gauges +
+comm_backend label, comm/xla_backend.py).
 
 Driven by ``BENCH_SMOKE=1 scripts/test.sh``. The point is that a metric
 regression (a renamed key, a gauge that silently stopped being computed,
@@ -159,6 +161,108 @@ def diloco_smoke() -> "list[str]":
     return failures
 
 
+# One in-process xla-backend allreduce round, exec'd in a child so the
+# forced host device count lands BEFORE jax initializes (env vars cannot
+# retrofit an already-built backend). Prints the backend-tagged gauge
+# surface as one JSON line.
+_XLA_SMOKE = r"""
+import json, sys, threading
+import numpy as np
+sys.path.insert(0, sys.argv[1])
+from torchft_tpu.comm.xla_backend import MeshManager, XlaCommContext
+
+world = 2
+mm = MeshManager()
+ctxs = [
+    XlaCommContext(timeout=30.0, algorithm="star", compression="int8",
+                   chunk_bytes=1 << 14, mesh_manager=mm)
+    for _ in range(world)
+]
+errs = []
+
+def worker(rank):
+    try:
+        ctx = ctxs[rank]
+        ctx.configure("xla://smoke", rank, world)
+        data = (np.arange(12345, dtype=np.float32) + 1) * (rank + 1)
+        ctx.allreduce([data]).future().result(timeout=30)
+    except Exception as e:
+        errs.append(repr(e))
+
+threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=120)
+snap = ctxs[0].metrics.snapshot()
+print(json.dumps({
+    "errors": errs,
+    "compile_count": mm.compile_count,
+    "gauges": {
+        k: snap.get(k)
+        for k in ("comm_backend", "comm_chunks", "comm_submit_wire_avg_ms",
+                  "comm_wire_reduce_avg_ms", "comm_op_wire_avg_ms")
+    },
+}))
+for c in ctxs:
+    c.shutdown()
+"""
+
+
+def xla_smoke() -> "list[str]":
+    """One on-device (forced-host-device) xla-backend allreduce round;
+    returns failure strings if the round fails or any backend-tagged
+    comm_* gauge is missing/non-finite. Extends the PR 3/4/5 smoke-gate
+    pattern to the new data plane."""
+    import math
+
+    env = {
+        k: v for k, v in os.environ.items() if k not in ("PYTHONPATH",)
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    out = None
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _XLA_SMOKE, _REPO],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, timeout=240,
+        )
+        payload = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        # The actual cause (jax import failure, crash before the JSON
+        # line) is on the child's stderr — surface it, not just the
+        # parse error. TimeoutExpired carries its own .stderr.
+        stderr = getattr(e, "stderr", None)
+        if stderr is None and out is not None:
+            stderr = out.stderr
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+        tail = (stderr or "").strip()[-2000:]
+        suffix = f"\n  child stderr: {tail}" if tail else ""
+        return [f"xla smoke: child failed to produce JSON: {e!r}{suffix}"]
+    failures = [f"xla smoke: {e}" for e in payload.get("errors", [])]
+    gauges = payload.get("gauges", {})
+    if gauges.get("comm_backend") != "xla":
+        failures.append(
+            "xla smoke: metrics sink not tagged comm_backend='xla': "
+            f"{gauges.get('comm_backend')!r}"
+        )
+    if not payload.get("compile_count"):
+        failures.append("xla smoke: no executable was compiled")
+    for key in ("comm_chunks", "comm_submit_wire_avg_ms",
+                "comm_wire_reduce_avg_ms", "comm_op_wire_avg_ms"):
+        v = gauges.get(key)
+        if v is None or not math.isfinite(float(v)) or float(v) < 0:
+            failures.append(
+                f"xla smoke: gauge {key!r} missing/non-finite: {v!r}"
+            )
+    return failures
+
+
 def main() -> int:
     env = {
         k: v for k, v in os.environ.items()
@@ -201,8 +305,10 @@ def main() -> int:
 
     failures = heal_smoke()
     failures += diloco_smoke()
+    failures += xla_smoke()
     for key in ("t1_pipeline_overlap", "t1_pipeline_ms", "t1_ddp_streamed",
-                "t1_overhead_ms", "t1_outer_overlap", "t1_outer_wire_ms"):
+                "t1_overhead_ms", "t1_outer_overlap", "t1_outer_wire_ms",
+                "comm_backend"):
         if key not in payload:
             failures.append(f"missing key {key!r}")
     classic = payload.get("t1_classic_steps") or 0
@@ -236,7 +342,8 @@ def main() -> int:
         f"overlap={payload['t1_pipeline_overlap']} "
         f"classic_steps={classic} "
         f"stages={sorted(payload['t1_pipeline_ms'])} "
-        "heal_gauges=ok outer_gauges=ok"
+        f"comm_backend={payload.get('comm_backend')} "
+        "heal_gauges=ok outer_gauges=ok xla_gauges=ok"
     )
     return 0
 

@@ -19,6 +19,24 @@ the deployment topology.
     python scripts/bench_transport.py --stripe-sweep   # chunk x lanes x codec
     python scripts/bench_transport.py --overlap-ab 5   # serial vs streamed
                                                        # multi-bucket schedule
+    python scripts/bench_transport.py --backend xla    # sweep the on-device
+                                                       # backend instead
+    python scripts/bench_transport.py --backend-ab 3   # host vs xla,
+                                                       # rep-interleaved
+
+--backend-ab runs the host (socket) and xla (on-device jax.lax,
+comm/xla_backend.py) data planes against identical seeded payloads,
+alternated rep-for-rep, with a BITWISE oracle every rep: both arms must
+produce byte-identical reduced results for every codec at the same
+chunk grid, or the run fails. Both arms use the SAME harness — one
+process per cell, one thread per rank (the xla group is in-process by
+construction) — so cells are comparable to each other but NOT to the
+process-per-rank cells above: the host arm's rank threads share a GIL
+(the r06 convoy effect), while the xla arm's compiled collective
+releases it. On the 2-core CPU sandbox the xla arm also pays device_put
+staging of every rank's contribution through one host — the ICI win
+this backend exists for is structurally invisible here; the evidence
+README carries the honest-null note.
 
 With chunk striping (PR 2) a single op rides ALL lanes, so channels>1
 changes single-op latency, not just multi-op overlap. `gbps` is the
@@ -104,6 +122,85 @@ if spec["rank"] == 0:
     )
     print(json.dumps({"lat": lat, "lane_balance": balance}))
 ctx.shutdown()
+"""
+
+# Thread-per-rank worker for --backend/--backend-ab cells: ONE process
+# hosts the whole cohort (the xla group's single-process rendezvous
+# requires it; the host arm uses the same shape so the A/B harness is
+# identical). Prints one JSON line: rank-0 cohort latencies + a sha256
+# of rank 0's reduced bytes after the last iteration — the bitwise
+# oracle the driver compares across arms.
+_THREAD_WORKER = r"""
+import hashlib, json, sys, threading, time
+spec = json.loads(sys.argv[1])
+sys.path.insert(0, spec["tree"])
+import numpy as np
+
+backend = spec["backend"]
+world = spec["world"]
+kw = dict(timeout=60.0, algorithm=spec["algorithm"],
+          chunk_bytes=spec["chunk_bytes"],
+          compression=spec["compression"])
+if backend == "xla":
+    from torchft_tpu.comm.xla_backend import XlaCommContext
+    ctxs = [XlaCommContext(**kw) for _ in range(world)]
+    addr_of = lambda r: "xla://%s" % spec["cell"]
+else:
+    from torchft_tpu.comm.transport import TcpCommContext
+    ctxs = [TcpCommContext(channels=spec["channels"], **kw)
+            for _ in range(world)]
+    addr_of = lambda r: spec["store"]
+
+elems = spec["nbytes"] // 4
+srcs = [
+    np.random.default_rng(spec["seed"] + r)
+    .standard_normal(elems).astype(np.float32)
+    for r in range(world)
+]
+datas = [np.empty(elems, dtype=np.float32) for _ in range(world)]
+barrier = threading.Barrier(world)
+lat = []
+digest = [None]
+errs = []
+
+def worker(rank):
+    try:
+        ctx = ctxs[rank]
+        ctx.configure(addr_of(rank), rank, world)
+        for i in range(spec["warmup"] + spec["iters"]):
+            np.copyto(datas[rank], srcs[rank])  # donation refill,
+            barrier.wait()                      # outside the window
+            if rank == 0:
+                t0 = time.perf_counter()
+            ctx.allreduce([datas[rank]]).future().result(timeout=60)
+            barrier.wait()
+            if rank == 0 and i >= spec["warmup"]:
+                lat.append(time.perf_counter() - t0)
+        if rank == 0:
+            digest[0] = hashlib.sha256(datas[0].tobytes()).hexdigest()
+    except Exception as e:
+        errs.append("rank %d: %r" % (rank, e))
+        try:
+            barrier.abort()
+        except Exception:
+            pass
+
+threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=600)
+if errs:
+    print(json.dumps({"error": "; ".join(errs)}))
+    sys.exit(1)
+snap = ctxs[0].metrics.snapshot()
+print(json.dumps({
+    "lat": lat, "digest": digest[0],
+    "comm_backend": snap.get("comm_backend"),
+    "comm_op_wire_avg_ms": snap.get("comm_op_wire_avg_ms"),
+}))
+for c in ctxs:
+    c.shutdown()
 """
 
 _CELL_SEQ = [0]
@@ -339,6 +436,122 @@ def _overlap_ab(store, payload_mb: int, iters_override, buckets: int,
     return cells
 
 
+def _thread_cell(store, backend, algorithm, world, nbytes, iters, warmup,
+                 channels=4, chunk_bytes=1 << 20, compression="none",
+                 seed=0, env=None):
+    """One thread-per-rank cell (see _THREAD_WORKER). Returns latency
+    percentiles + the rank-0 result digest (the bitwise oracle)."""
+    import os
+
+    _CELL_SEQ[0] += 1
+    prefix = f"bt{_CELL_SEQ[0]}"
+    spec = {
+        "tree": str(_REPO), "backend": backend, "cell": prefix,
+        "store": f"{store.addr}/{prefix}",
+        "world": world, "algorithm": algorithm, "channels": channels,
+        "chunk_bytes": chunk_bytes, "compression": compression,
+        "nbytes": nbytes, "iters": iters, "warmup": warmup, "seed": seed,
+    }
+    child_env = dict(os.environ)
+    child_env.pop("PYTHONPATH", None)
+    # The xla arm needs >= world virtual CPU devices BEFORE jax inits;
+    # harmless for the host arm (which never imports jax). RESPECT a
+    # caller-set JAX_PLATFORMS: on a real TPU host `JAX_PLATFORMS=tpu
+    # bench_transport.py --backend xla` must measure the device plane,
+    # not a silently CPU-emulated one tagged "xla".
+    child_env.setdefault("JAX_PLATFORMS", "cpu")
+    if child_env["JAX_PLATFORMS"] == "cpu":
+        child_env["XLA_FLAGS"] = (
+            child_env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={max(world, 4)}"
+        ).strip()
+    if env:
+        child_env.update(env)
+    out = subprocess.run(
+        [sys.executable, "-c", _THREAD_WORKER, json.dumps(spec)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=600,
+        env=child_env,
+    )
+    lines = out.stdout.decode().strip().splitlines()
+    if not lines:
+        raise RuntimeError(
+            f"cell {prefix} ({backend}) produced no output "
+            f"(rc={out.returncode}): {out.stderr.decode()[-2000:]}"
+        )
+    payload = json.loads(lines[-1])
+    if out.returncode != 0 or "error" in payload:
+        raise RuntimeError(
+            f"cell {prefix} ({backend}) failed: {payload.get('error')}"
+        )
+    res = _percentiles(payload["lat"])
+    res["digest"] = payload["digest"]
+    res["comm_backend"] = payload["comm_backend"]
+    return res
+
+
+def _backend_ab(store, payload_mb: int, iters_override, reps: int) -> list:
+    """Rep-interleaved host-vs-xla A/B with a bitwise oracle every rep
+    (PR 2-5 pattern: warmup reps inside each cell, gc outside windows,
+    arms alternated so host-load drift hits both equally). Fails loudly
+    if any (config, rep) pair's reduced bytes diverge across arms."""
+    import gc
+
+    nbytes = payload_mb << 20
+    iters = iters_override or 8
+    configs = [
+        dict(algorithm=algorithm, world=world, compression=codec,
+             label=f"{algorithm}_w{world}_{codec}")
+        for algorithm, world in (("star", 2), ("ring", 3))
+        for codec in ("none", "bf16", "int8")
+    ]
+    runs: dict = {c["label"]: {"host": [], "xla": []} for c in configs}
+    oracle_ok = True
+    for rep in range(reps):
+        for c in configs:
+            digests = {}
+            for backend in ("host", "xla"):
+                gc.collect()
+                res = _thread_cell(
+                    store, backend, c["algorithm"], c["world"], nbytes,
+                    iters=iters, warmup=2, compression=c["compression"],
+                    seed=1000 + rep,  # same inputs across arms, per rep
+                )
+                digests[backend] = res["digest"]
+                runs[c["label"]][backend].append(res)
+                print(
+                    f"# rep{rep} {c['label']} {backend}: "
+                    f"avg {res['avg_ms']:.1f}ms p50 {res['p50_ms']:.1f}ms",
+                    file=sys.stderr,
+                )
+            if digests["host"] != digests["xla"]:
+                oracle_ok = False
+                print(
+                    f"# BITWISE MISMATCH rep{rep} {c['label']}: "
+                    f"{digests}", file=sys.stderr,
+                )
+    cells = []
+    for c in configs:
+        cell = {
+            "label": c["label"], "algorithm": c["algorithm"],
+            "world": c["world"], "compression": c["compression"],
+            "payload_bytes": nbytes, "iters": iters, "reps": reps,
+            "workers": "thread-per-rank",
+        }
+        for backend in ("host", "xla"):
+            avgs = sorted(r["avg_ms"] for r in runs[c["label"]][backend])
+            cell[f"{backend}_median_avg_ms"] = round(avgs[len(avgs) // 2], 3)
+            cell[f"{backend}_rep_avg_ms"] = [round(a, 3) for a in avgs]
+        cell["bitwise"] = all(
+            runs[c["label"]]["host"][i]["digest"]
+            == runs[c["label"]]["xla"][i]["digest"]
+            for i in range(reps)
+        )
+        cells.append(cell)
+    if not oracle_ok:
+        raise SystemExit("backend A/B: bitwise oracle FAILED (see stderr)")
+    return cells
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true", help="add 32MB payloads")
@@ -367,13 +580,39 @@ def main() -> None:
         "--overlap-buckets", type=int, default=4, metavar="B",
         help="bucket count for --overlap-ab (payload is split B ways)",
     )
+    ap.add_argument(
+        "--backend", choices=("host", "xla"), default="host",
+        help="data plane for the default sweep: host sockets "
+        "(process-per-rank) or on-device jax.lax collectives "
+        "(thread-per-rank, comm/xla_backend.py)",
+    )
+    ap.add_argument(
+        "--backend-ab", type=int, default=0, metavar="N",
+        help="host-vs-xla A/B at --sweep-payload-mb, alternated N reps "
+        "with a bitwise oracle every rep (both arms thread-per-rank)",
+    )
     args = ap.parse_args()
+    if args.backend == "xla" and (
+        args.stripe_sweep or args.overlap_ab
+        or (args.ab_repeat and args.ab_baseline)
+    ):
+        # Those modes run host-plane cells regardless of --backend; an
+        # artifact claiming "xla" for them would lie about its numbers.
+        ap.error(
+            "--backend xla applies only to the default sweep (or use "
+            "--backend-ab); --stripe-sweep/--overlap-ab/--ab-repeat "
+            "measure the host plane's lane machinery"
+        )
 
     cells = []
     t_start = time.perf_counter()
     store = StoreServer()
     try:
-        if args.overlap_ab:
+        if args.backend_ab:
+            cells = _backend_ab(
+                store, args.sweep_payload_mb, args.iters, args.backend_ab,
+            )
+        elif args.overlap_ab:
             cells = _overlap_ab(
                 store, args.sweep_payload_mb, args.iters,
                 args.overlap_buckets, args.overlap_ab,
@@ -395,20 +634,32 @@ def main() -> None:
             for nbytes in sizes:
                 iters = args.iters or max(5, min(30, (8 << 20) // nbytes * 4))
                 for algorithm, world in (("star", 2), ("ring", 3)):
-                    for channels in (1, 4):
-                        res = _bench_config(
-                            store, algorithm, world, channels, nbytes,
-                            iters=iters, warmup=3,
-                        )
+                    # lanes are a host-plane concept: the xla backend
+                    # rides one fused executable, so one cell per config
+                    for channels in ((1, 4) if args.backend == "host"
+                                     else (1,)):
+                        if args.backend == "xla":
+                            res = _thread_cell(
+                                store, "xla", algorithm, world, nbytes,
+                                iters=iters, warmup=3,
+                            )
+                            res.pop("digest", None)
+                        else:
+                            res = _bench_config(
+                                store, algorithm, world, channels, nbytes,
+                                iters=iters, warmup=3,
+                            )
                         cell = _finish_cell(
                             res, nbytes,
+                            backend=args.backend,
                             algorithm=algorithm, world=world,
                             channels=channels, iters=iters,
                         )
                         cells.append(cell)
                         print(
-                            f"# {algorithm} w{world} c{channels} "
-                            f"{nbytes >> 10}KB: avg {cell['avg_ms']}ms "
+                            f"# {args.backend} {algorithm} w{world} "
+                            f"c{channels} {nbytes >> 10}KB: "
+                            f"avg {cell['avg_ms']}ms "
                             f"p95 {cell['p95_ms']}ms",
                             file=sys.stderr,
                         )
@@ -417,12 +668,20 @@ def main() -> None:
 
     print(json.dumps({
         "bench": (
-            "transport_overlap_ab" if args.overlap_ab
+            "transport_backend_ab" if args.backend_ab
+            else "transport_overlap_ab" if args.overlap_ab
             else "transport_stripe_ab" if args.ab_repeat and args.ab_baseline
             else "transport_stripe_sweep" if args.stripe_sweep
             else "transport_loopback_allreduce"
         ),
-        "workers": "process-per-rank",
+        # Only the default sweep and --backend-ab ever run xla cells;
+        # the guard above rejects --backend xla for the other modes.
+        "comm_backend": "host+xla" if args.backend_ab else args.backend,
+        "workers": (
+            "thread-per-rank"
+            if args.backend_ab or args.backend == "xla"
+            else "process-per-rank"
+        ),
         "wall_s": round(time.perf_counter() - t_start, 1),
         "cells": cells,
     }))

@@ -1,0 +1,726 @@
+"""On-device data plane: XlaCommContext parity, mesh churn, lifecycle.
+
+The conftest forces an 8-device virtual CPU platform
+(--xla_force_host_platform_device_count), so the on-device backend runs
+its real shard_map collectives here — the "testable on the CPU sandbox"
+contract from the module docstring of comm/xla_backend.py.
+
+The two load-bearing suites:
+
+* **Bitwise parity** — the socket transport is the oracle: for the same
+  chunk grid, every codec (none/bf16/int8), both accumulation orders
+  (star and ring), at 2 AND 4 devices, the on-device allreduce must
+  reproduce the host wire's bytes exactly. This is what lets the host
+  plane remain the cross-host A/B and the EF arena share one residual
+  definition across backends.
+
+* **Membership churn without retrace storms** — a replica dying costs
+  one executable-cache lookup at the step boundary (or one compile on
+  FIRST sight of that world size), never a per-step retrace.
+  ``MeshManager.compile_count``/``trace_count`` pin this.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.comm.context import ReduceOp
+from torchft_tpu.comm.store import StoreServer
+from torchft_tpu.comm.transport import TcpCommContext
+from torchft_tpu.comm.xla_backend import (
+    MeshManager,
+    XlaCommContext,
+    default_mesh_manager,
+)
+
+CHUNK = 1 << 12  # small grid: multiple chunks + per-chunk int8 scales
+
+
+@pytest.fixture(scope="module")
+def store():
+    s = StoreServer()
+    yield s
+    s.shutdown()
+
+
+@pytest.fixture(scope="module")
+def mesh_mgr():
+    # One pool for the whole module: executables cache across tests,
+    # like one training process surviving many quorum epochs.
+    return MeshManager()
+
+
+def _inputs(world: int, seed: int, floats_only: bool = False):
+    rng = np.random.default_rng(seed)
+    out = []
+    for r in range(world):
+        per = [
+            (rng.standard_normal(5000) * (r + 1)).astype(np.float32),
+            rng.standard_normal(257).astype(np.float32),
+        ]
+        if not floats_only:
+            per.append(rng.integers(-50, 50, 1000).astype(np.int32))
+        out.append(per)
+    return out
+
+
+def _run_cohort(ctxs, addr_of, world, body, timeout=60.0):
+    """Configure each rank's context and run ``body(ctx, rank)`` on a
+    thread per rank (the single-process stand-in for the SPMD launch)."""
+    results = [None] * world
+
+    def _worker(rank):
+        ctxs[rank].configure(addr_of(rank), rank, world)
+        results[rank] = body(ctxs[rank], rank)
+
+    with ThreadPoolExecutor(max_workers=world) as pool:
+        for f in [pool.submit(_worker, r) for r in range(world)]:
+            f.result(timeout=timeout)
+    return results
+
+
+def _allreduce_body(inputs, op):
+    def body(ctx, rank):
+        w = ctx.allreduce([a.copy() for a in inputs[rank]], op)
+        return [np.array(x) for x in w.future().result(timeout=30)]
+
+    return body
+
+
+def _host_results(store, tag, world, algo, codec, inputs, op):
+    ctxs = [
+        TcpCommContext(timeout=30.0, algorithm=algo, channels=2,
+                       compression=codec, chunk_bytes=CHUNK)
+        for _ in range(world)
+    ]
+    try:
+        return _run_cohort(
+            ctxs, lambda r: f"{store.addr}/{tag}", world,
+            _allreduce_body(inputs, op),
+        )
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+def _xla_results(mesh_mgr, tag, world, algo, codec, inputs, op):
+    ctxs = [
+        XlaCommContext(timeout=30.0, algorithm=algo, compression=codec,
+                       chunk_bytes=CHUNK, mesh_manager=mesh_mgr)
+        for _ in range(world)
+    ]
+    try:
+        return _run_cohort(
+            ctxs, lambda r: f"xla://{tag}", world,
+            _allreduce_body(inputs, op),
+        )
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+# ------------------------------------------------------------- parity
+
+
+@pytest.mark.parametrize("world", [2, 4])
+@pytest.mark.parametrize("algo", ["star", "ring"])
+@pytest.mark.parametrize("codec", ["none", "bf16", "int8"])
+def test_allreduce_bitwise_matches_host(store, mesh_mgr, world, algo,
+                                        codec) -> None:
+    # SUM over a mixed payload (f32 + int32: the int leaves ride the
+    # wire uncompressed in both planes) and AVG over the float leaves.
+    for op, floats_only in ((ReduceOp.SUM, False), (ReduceOp.AVG, True)):
+        inputs = _inputs(world, seed=world * 7 + 1, floats_only=floats_only)
+        tag = f"par_{world}_{algo}_{codec}_{op}"
+        host = _host_results(store, "h" + tag, world, algo, codec,
+                             inputs, op)
+        xla = _xla_results(mesh_mgr, "x" + tag, world, algo, codec,
+                           inputs, op)
+        for r in range(world):
+            for i, (h, x) in enumerate(zip(host[r], xla[r])):
+                assert h.dtype == x.dtype and h.shape == x.shape
+                assert h.tobytes() == x.tobytes(), (
+                    f"{tag}: rank {r} array {i} diverged "
+                    f"({int((h != x).sum())}/{h.size} elements)"
+                )
+
+
+def test_allreduce_half_dtype_avg_parity(store, mesh_mgr) -> None:
+    # f16/bf16 live on the device plane; AVG divides there in promoted
+    # f32 while the host divides in the native dtype — bitwise-equal
+    # anyway because numpy's half arithmetic is itself emulated via a
+    # single f32 op rounded back (verified exhaustively over all finite
+    # f16/bf16 values for small divisors). Pin the end-to-end contract.
+    import ml_dtypes
+
+    world = 3
+    rng = np.random.default_rng(17)
+    inputs = [
+        [
+            (rng.standard_normal(700) * (r + 1)).astype(np.float16),
+            (rng.standard_normal(500) * (r + 1)).astype(ml_dtypes.bfloat16),
+        ]
+        for r in range(world)
+    ]
+    for algo in ("star", "ring"):
+        for op in (ReduceOp.SUM, ReduceOp.AVG):
+            tag = f"half_{algo}_{op}"
+            host = _host_results(store, "h" + tag, world, algo, "none",
+                                 inputs, op)
+            xla = _xla_results(mesh_mgr, "x" + tag, world, algo, "none",
+                               inputs, op)
+            for r in range(world):
+                for h, x in zip(host[r], xla[r]):
+                    assert h.dtype == x.dtype
+                    assert h.tobytes() == x.tobytes(), (tag, r)
+
+
+def test_allgather_results_are_private_per_rank(store, mesh_mgr) -> None:
+    # Each rank's allgather result must be ITS OWN buffers (host-plane
+    # semantics: per-rank decoded arrays) — a rank mutating its result
+    # in place must not corrupt a peer's view.
+    world = 2
+    ctxs = [XlaCommContext(timeout=30.0, mesh_manager=mesh_mgr)
+            for _ in range(world)]
+    try:
+        def body(ctx, rank):
+            mine = np.full(4, float(rank), np.float32)
+            return ctx.allgather([mine]).future().result(timeout=15)
+
+        results = _run_cohort(ctxs, lambda r: "xla://agpriv", world, body)
+        results[0][0][0][:] = 777.0  # rank 0 mutates its received copy
+        for src in range(world):
+            assert np.array_equal(
+                results[1][src][0], np.full(4, float(src), np.float32)
+            )
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+def test_allreduce_parity_with_f64_host_fallback(store, mesh_mgr) -> None:
+    # 64-bit leaves cannot live on the forced-host device plane; they
+    # reduce through the in-group host simulation, which runs the REAL
+    # transport codec code — parity must hold for a payload mixing both.
+    world = 2
+    rng = np.random.default_rng(11)
+    inputs = [
+        [
+            (rng.standard_normal(999) * (r + 1)).astype(np.float32),
+            (rng.standard_normal(333) * (r + 1)).astype(np.float64),
+            rng.integers(-(2**40), 2**40, 100).astype(np.int64),
+        ]
+        for r in range(world)
+    ]
+    for algo in ("star", "ring"):
+        host = _host_results(store, f"hf64_{algo}", world, algo, "int8",
+                             inputs, ReduceOp.SUM)
+        xla = _xla_results(mesh_mgr, f"xf64_{algo}", world, algo, "int8",
+                           inputs, ReduceOp.SUM)
+        for r in range(world):
+            for h, x in zip(host[r], xla[r]):
+                assert h.tobytes() == x.tobytes()
+
+
+def test_wire_surface_matches_host() -> None:
+    # The EF arena computes residuals against wire_roundtrip and sizes
+    # gauges with wire_nbytes THROUGH the manager — both backends must
+    # report identical images/sizes for the same codec + grid, and the
+    # same role-aware compensability.
+    rng = np.random.default_rng(3)
+    src = rng.standard_normal(6000).astype(np.float32)
+    for codec in ("bf16", "int8"):
+        tcp = TcpCommContext(algorithm="star", compression=codec,
+                             chunk_bytes=CHUNK)
+        xla = XlaCommContext(algorithm="star", compression=codec,
+                             chunk_bytes=CHUNK)
+        for ctx, rank in ((tcp, 1), (xla, 1)):
+            ctx._rank, ctx._world_size = rank, 2  # star peer: compensable
+        assert tcp.wire_compensable() and xla.wire_compensable()
+        out_t = np.empty_like(src)
+        out_x = np.empty_like(src)
+        tcp.wire_roundtrip(src, out_t)
+        xla.wire_roundtrip(src, out_x)
+        assert out_t.tobytes() == out_x.tobytes()
+        assert tcp.wire_nbytes(src) == xla.wire_nbytes(src)
+        assert xla.wire_codec_name() == codec and xla.wire_is_lossy()
+        # star root / ring member: never compensable, either backend
+        xla._rank = 0
+        assert not xla.wire_compensable()
+        ring = XlaCommContext(algorithm="ring", compression=codec)
+        ring._rank, ring._world_size = 1, 4
+        assert not ring.wire_compensable()
+
+
+def test_ddp_step_parity_int8_ef(store, mesh_mgr) -> None:
+    # Full DDP rounds (staging arena, EF residual lifecycle, AVG
+    # scaling) over both backends: the per-step averaged trees must be
+    # bitwise identical — int8+EF is the satellite's hardest case.
+    from torchft_tpu.ddp import DistributedDataParallel
+    from torchft_tpu.utils.wire_stub import WireStubManager
+
+    world, steps = 2, 3
+    rng = np.random.default_rng(5)
+    grads = [
+        {
+            "w": (rng.standard_normal((64, 33)) * (r + 1)).astype(
+                np.float32
+            ),
+            "b": (rng.standard_normal(77) * (r + 1)).astype(np.float32),
+        }
+        for r in range(world)
+    ]
+
+    def run(backend: str, tag: str):
+        if backend == "host":
+            ctxs = [
+                TcpCommContext(timeout=30.0, algorithm="star", channels=2,
+                               compression="int8", chunk_bytes=CHUNK)
+                for _ in range(world)
+            ]
+            addr_of = lambda r: f"{store.addr}/{tag}"  # noqa: E731
+        else:
+            ctxs = [
+                XlaCommContext(timeout=30.0, algorithm="star",
+                               compression="int8", chunk_bytes=CHUNK,
+                               mesh_manager=mesh_mgr)
+                for _ in range(world)
+            ]
+            addr_of = lambda r: f"xla://{tag}"  # noqa: E731
+
+        def body(ctx, rank):
+            stub = WireStubManager(ctx, world)
+            assert stub.comm_backend() == backend
+            ddp = DistributedDataParallel(stub, bucket_bytes=8192)
+            out = []
+            for _ in range(steps):
+                avg = ddp.average_gradients(grads[rank])
+                out.append({k: np.asarray(v).copy() for k, v in avg.items()})
+            return out
+
+        try:
+            return _run_cohort(ctxs, addr_of, world, body)
+        finally:
+            for c in ctxs:
+                c.shutdown()
+
+    host = run("host", "ddp_h")
+    xla = run("xla", "ddp_x")
+    for r in range(world):
+        for t in range(steps):
+            for k in host[r][t]:
+                assert host[r][t][k].tobytes() == xla[r][t][k].tobytes(), (
+                    f"DDP int8+EF diverged: rank {r} step {t} leaf {k!r}"
+                )
+
+
+def test_diloco_outer_round_parity_int8(store, mesh_mgr) -> None:
+    # The outer plane (local_sgd.py streaming fragments: staggered
+    # non-blocking allreduces, EF residuals, per-round commit) must be
+    # backend-agnostic: a full streaming-DiLoCo round over the xla
+    # backend commits the same bytes as over the socket transport.
+    import optax
+
+    import jax.numpy as jnp
+    from torchft_tpu.local_sgd import DiLoCo
+    from torchft_tpu.utils.wire_stub import WireStubManager
+
+    world, sync_every, fragments = 2, 4, 2
+
+    def run(backend: str, tag: str):
+        if backend == "host":
+            ctxs = [
+                TcpCommContext(timeout=30.0, algorithm="star", channels=2,
+                               compression="int8", chunk_bytes=CHUNK)
+                for _ in range(world)
+            ]
+            addr_of = lambda r: f"{store.addr}/{tag}"  # noqa: E731
+        else:
+            ctxs = [
+                XlaCommContext(timeout=30.0, algorithm="star",
+                               compression="int8", chunk_bytes=CHUNK,
+                               mesh_manager=mesh_mgr)
+                for _ in range(world)
+            ]
+            addr_of = lambda r: f"xla://{tag}"  # noqa: E731
+
+        def body(ctx, rank):
+            manager = WireStubManager(ctx, world)
+            wrapper = DiLoCo(manager, optax.sgd(0.7),
+                             sync_every=sync_every,
+                             num_fragments=fragments, streaming=True)
+            rng = np.random.default_rng(0)  # identical init every rank
+            params = wrapper.register({
+                "w": jnp.asarray(
+                    rng.standard_normal(4096).astype(np.float32)
+                ),
+                "b": jnp.asarray(
+                    rng.standard_normal(257).astype(np.float32)
+                ),
+            })
+            for _ in range(sync_every):
+                scale = np.float32(0.99 - 0.01 * rank)
+                params = {k: params[k] * scale for k in params}
+                params = wrapper.step(params)
+            return {k: np.asarray(v).tobytes() for k, v in params.items()}
+
+        try:
+            return _run_cohort(ctxs, addr_of, world, body)
+        finally:
+            for c in ctxs:
+                c.shutdown()
+
+    host = run("host", "dlc_h")
+    xla = run("xla", "dlc_x")
+    assert host[0] == host[1] and xla[0] == xla[1]  # ranks agree
+    for r in range(world):
+        for k in host[r]:
+            assert host[r][k] == xla[r][k], (
+                f"DiLoCo outer round diverged across backends: "
+                f"rank {r} leaf {k!r}"
+            )
+
+
+# ------------------------------------------- mesh churn / compile cache
+
+
+def test_mesh_reconfigure_compile_counts() -> None:
+    # The perf architecture: first sight of a world size compiles once;
+    # every later quorum at ANY previously-seen world size is a cache
+    # hit with ZERO new traces — a death costs a lookup, not a retrace.
+    mm = MeshManager()
+    inputs4 = _inputs(4, seed=42, floats_only=True)
+    inputs3 = _inputs(3, seed=43, floats_only=True)
+
+    def make(n):
+        return [
+            XlaCommContext(timeout=15.0, algorithm="star",
+                           compression="none", chunk_bytes=CHUNK,
+                           mesh_manager=mm)
+            for _ in range(n)
+        ]
+
+    ctxs = make(4)
+    _run_cohort(ctxs, lambda r: "xla://churn/e1", 4,
+                _allreduce_body(inputs4, ReduceOp.SUM))
+    assert mm.compile_count == 1 and mm.trace_count == 1
+
+    # steady state at the same world size: pure cache hits
+    hits0 = mm.hit_count
+    _run_cohort(ctxs, lambda r: "xla://churn/e1b", 4,
+                _allreduce_body(inputs4, ReduceOp.SUM))
+    assert mm.compile_count == 1 and mm.trace_count == 1
+    assert mm.hit_count > hits0
+
+    # replica 3 dies; survivors reconfigure at the step boundary.
+    # First sight of world_size=3: exactly ONE new compile.
+    ctxs[3].shutdown()
+    survivors = ctxs[:3]
+    _run_cohort(survivors, lambda r: "xla://churn/e2", 3,
+                _allreduce_body(inputs3, ReduceOp.SUM))
+    assert mm.compile_count == 2 and mm.trace_count == 2
+
+    # the replica comes back: world_size=4 was seen before — ZERO new
+    # compiles, zero new traces, the executable comes from the cache.
+    ctxs = make(4)
+    hits1 = mm.hit_count
+    _run_cohort(ctxs, lambda r: "xla://churn/e3", 4,
+                _allreduce_body(inputs4, ReduceOp.SUM))
+    assert mm.compile_count == 2 and mm.trace_count == 2
+    assert mm.hit_count > hits1
+    for c in ctxs:
+        c.shutdown()
+
+    # distinct layouts/codecs are distinct executables (keyed, not
+    # retraced): a different payload layout compiles once more
+    inputs_alt = [[np.ones(17, np.float32) * r] for r in range(4)]
+    ctxs = make(4)
+    _run_cohort(ctxs, lambda r: "xla://churn/e4", 4,
+                _allreduce_body(inputs_alt, ReduceOp.SUM))
+    assert mm.compile_count == 3 and mm.trace_count == 3
+    for c in ctxs:
+        c.shutdown()
+
+
+def test_mesh_world_size_exceeds_pool_raises() -> None:
+    mm = MeshManager(devices=[object(), object()])
+    with pytest.raises(ValueError, match="exceeds the device pool"):
+        mm.mesh_for(3)
+
+
+def test_default_mesh_manager_is_process_wide() -> None:
+    assert default_mesh_manager() is default_mesh_manager()
+
+
+# --------------------------------------------------- lifecycle / errors
+
+
+def test_dead_member_fails_op_and_latches() -> None:
+    # rank 1 never submits its share: the straggler deadline fails the
+    # op with ConnectionError (the Manager latches it like a dead
+    # socket), and later submits fail fast on the latched context.
+    world = 2
+    mm = MeshManager()
+    ctxs = [
+        XlaCommContext(timeout=1.0, algorithm="star", mesh_manager=mm)
+        for _ in range(world)
+    ]
+    _run_cohort(ctxs, lambda r: "xla://dead", world,
+                lambda ctx, rank: None)
+    w = ctxs[0].allreduce([np.ones(8, np.float32)])
+    with pytest.raises(ConnectionError, match="timed out waiting"):
+        w.future().result(timeout=10)
+    assert isinstance(ctxs[0].errored(), ConnectionError)
+    w2 = ctxs[0].allreduce([np.ones(8, np.float32)])
+    with pytest.raises(ConnectionError, match="previously errored"):
+        w2.future().result(timeout=5)
+    for c in ctxs:
+        c.shutdown()
+
+
+def test_member_shutdown_fails_peers_fast() -> None:
+    # A member tearing down (reconfigure/death) closes the group: the
+    # peer's next op fails with ConnectionError instead of hanging out
+    # the full timeout.
+    world = 2
+    mm = MeshManager()
+    ctxs = [
+        XlaCommContext(timeout=30.0, mesh_manager=mm)
+        for _ in range(world)
+    ]
+    _run_cohort(ctxs, lambda r: "xla://teardown", world,
+                lambda ctx, rank: None)
+    ctxs[1].shutdown()
+    w = ctxs[0].allreduce([np.ones(8, np.float32)])
+    with pytest.raises(ConnectionError):
+        w.future().result(timeout=10)
+    ctxs[0].shutdown()
+
+
+def test_failed_rendezvous_allows_retry() -> None:
+    # A rank whose peers never arrive times out of configure; a RETRY on
+    # the same store address (same quorum id) must re-attempt the
+    # rendezvous — not die on 'duplicate rank' against its own stale
+    # registration — and succeed once the peer shows up.
+    world = 2
+    mm = MeshManager()
+    lone = XlaCommContext(timeout=0.3, algorithm="star", mesh_manager=mm)
+    with pytest.raises(TimeoutError, match="before timeout"):
+        lone.configure("xla://retry", 0, world)
+    ctxs = [
+        XlaCommContext(timeout=30.0, algorithm="star", mesh_manager=mm)
+        for _ in range(world)
+    ]
+    results = _run_cohort(
+        ctxs, lambda r: "xla://retry", world,
+        _allreduce_body([[np.full(64, r + 1, np.float32)]
+                         for r in range(world)], ReduceOp.SUM),
+    )
+    assert np.array_equal(results[0][0], np.full(64, 3.0, np.float32))
+    for c in ctxs:
+        c.shutdown()
+
+
+def test_executable_concurrent_build_compiles_once() -> None:
+    # Two contexts racing on the same cache key (two Managers sharing
+    # the default pool) must not duplicate the compile: one builds, the
+    # waiter blocks on the in-flight future, compile_count stays 1.
+    mm = MeshManager(devices=[object()])
+    started = threading.Event()
+    release = threading.Event()
+    builds = [0]
+
+    def build():
+        builds[0] += 1
+        started.set()
+        release.wait(timeout=10)
+        return "exe"
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        f1 = pool.submit(mm.executable, ("k",), build)
+        started.wait(timeout=10)
+        f2 = pool.submit(mm.executable, ("k",), build)
+        release.set()
+        assert f1.result(timeout=10) == "exe"
+        assert f2.result(timeout=10) == "exe"
+    assert builds[0] == 1 and mm.compile_count == 1
+    assert mm.executable(("k",), build) == "exe" and mm.compile_count == 1
+
+
+def test_solo_world_is_identity() -> None:
+    ctx = XlaCommContext(mesh_manager=MeshManager())
+    ctx.configure("xla://solo/0", 0, 1)
+    a = np.arange(16, dtype=np.float32)
+    out = ctx.allreduce([a.copy()]).future().result(timeout=5)
+    assert np.array_equal(out[0], a)
+    gathered = ctx.allgather([a]).future().result(timeout=5)
+    assert len(gathered) == 1 and np.array_equal(gathered[0][0], a)
+    ctx.shutdown()
+
+
+def test_broadcast_and_allgather(mesh_mgr) -> None:
+    world = 3
+    ctxs = [
+        XlaCommContext(timeout=15.0, mesh_manager=mesh_mgr)
+        for _ in range(world)
+    ]
+
+    def body(ctx, rank):
+        mine = np.full(4, float(rank), np.float32)
+        bc = ctx.broadcast([mine.copy()], root=1).future().result(
+            timeout=15
+        )
+        ag = ctx.allgather([mine]).future().result(timeout=15)
+        # per-rank DIVERGENT layouts are legal for allgather (the host
+        # plane self-describes each rank's arrays — variable-length
+        # state is the normal use)
+        varied = np.arange(rank + 1, dtype=np.float32)
+        agv = ctx.allgather([varied]).future().result(timeout=15)
+        return bc, ag, agv
+
+    results = _run_cohort(ctxs, lambda r: "xla://bcag", world, body)
+    for rank, (bc, ag, agv) in enumerate(results):
+        assert np.array_equal(bc[0], np.full(4, 1.0, np.float32))
+        assert len(ag) == world
+        for src in range(world):
+            assert np.array_equal(
+                ag[src][0], np.full(4, float(src), np.float32)
+            )
+            assert np.array_equal(
+                agv[src][0], np.arange(src + 1, dtype=np.float32)
+            )
+    for c in ctxs:
+        c.shutdown()
+
+
+def test_psum_algorithm_runs(mesh_mgr) -> None:
+    # "psum" is the hardware-native fast path: XLA owns the reduction
+    # order, so the oracle is numeric, not bitwise.
+    world = 4
+    inputs = _inputs(world, seed=9, floats_only=True)
+    ctxs = [
+        XlaCommContext(timeout=15.0, algorithm="psum",
+                       mesh_manager=mesh_mgr)
+        for _ in range(world)
+    ]
+    results = _run_cohort(ctxs, lambda r: "xla://psum", world,
+                          _allreduce_body(inputs, ReduceOp.SUM))
+    expected = [
+        np.sum([inputs[r][i] for r in range(world)], axis=0)
+        for i in range(len(inputs[0]))
+    ]
+    for r in range(world):
+        for got, exp in zip(results[r], expected):
+            np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-5)
+    for c in ctxs:
+        c.shutdown()
+
+
+def test_validation_errors() -> None:
+    with pytest.raises(ValueError, match="cannot carry a wire codec"):
+        XlaCommContext(algorithm="psum", compression="int8")
+    with pytest.raises(ValueError, match="unknown algorithm"):
+        XlaCommContext(algorithm="tree")
+    with pytest.raises(ValueError, match="unknown compression"):
+        XlaCommContext(compression="zstd")
+    # mismatched settings across ranks must fail the rendezvous
+    mm = MeshManager()
+    a = XlaCommContext(timeout=5.0, compression="int8",
+                       algorithm="star", mesh_manager=mm)
+    b = XlaCommContext(timeout=5.0, compression="bf16",
+                       algorithm="star", mesh_manager=mm)
+    errs = []
+
+    def _worker(ctx, rank):
+        try:
+            ctx.configure("xla://mismatch", rank, 2)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [
+        threading.Thread(target=_worker, args=(c, r))
+        for r, c in enumerate((a, b))
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert any("must match across ranks" in str(e) for e in errs), errs
+    a.shutdown()
+    b.shutdown()
+
+
+def test_metrics_backend_label_and_spans(mesh_mgr) -> None:
+    # Per-op spans land in EACH member's sink under the host transport's
+    # names, tagged comm_backend="xla" — a host-vs-xla A/B compares
+    # identical keys distinguished by the label.
+    world = 2
+    inputs = _inputs(world, seed=21, floats_only=True)
+    ctxs = [
+        XlaCommContext(timeout=15.0, algorithm="star",
+                       mesh_manager=mesh_mgr)
+        for _ in range(world)
+    ]
+    _run_cohort(ctxs, lambda r: "xla://met", world,
+                _allreduce_body(inputs, ReduceOp.SUM))
+    for ctx in ctxs:
+        snap = ctx.metrics.snapshot()
+        assert snap.get("comm_backend") == "xla"
+        for key in ("comm_submit_wire_avg_ms", "comm_wire_reduce_avg_ms",
+                    "comm_op_wire_avg_ms"):
+            assert key in snap and np.isfinite(snap[key]), (key, snap)
+        assert snap.get("comm_chunks", 0) > 0
+    for c in ctxs:
+        c.shutdown()
+
+
+def test_manager_comm_backend_selector() -> None:
+    from torchft_tpu.manager import Manager, _build_comm_context
+
+    assert isinstance(_build_comm_context("host", None, 5.0),
+                      TcpCommContext)
+    xc = _build_comm_context(
+        "xla", {"compression": "bf16", "chunk_bytes": 123}, 5.0
+    )
+    assert isinstance(xc, XlaCommContext)
+    assert xc.wire_codec_name() == "bf16" and xc._chunk_bytes == 123
+    with pytest.raises(ValueError, match="unknown comm_backend"):
+        _build_comm_context("nccl", None, 5.0)
+    # a provided context must agree with an explicit selector
+    with pytest.raises(ValueError, match="backend 'host'"):
+        Manager(comm=TcpCommContext(timeout=1.0), comm_backend="xla",
+                min_replica_size=1)
+    with pytest.raises(ValueError, match="comm_options applies only"):
+        Manager(comm=TcpCommContext(timeout=1.0),
+                comm_options={"channels": 2}, min_replica_size=1)
+    # min_replica_size has no safe default: omitting it must fail at
+    # construction, not quietly run with a quorum floor of 1
+    with pytest.raises(TypeError, match="min_replica_size"):
+        Manager(comm=TcpCommContext(timeout=1.0))
+
+
+def test_donation_contract_result_aliases_input(mesh_mgr) -> None:
+    # The future resolves to the very arrays submitted, reduced in place
+    # — the DDP staging arena relies on this exactly as with sockets.
+    world = 2
+    ctxs = [
+        XlaCommContext(timeout=15.0, mesh_manager=mesh_mgr)
+        for _ in range(world)
+    ]
+    donated = [np.full(32, float(r + 1), np.float32) for r in range(world)]
+
+    def body(ctx, rank):
+        w = ctx.allreduce([donated[rank]])
+        out = w.future().result(timeout=15)
+        return out[0] is donated[rank]
+
+    aliased = _run_cohort(ctxs, lambda r: "xla://don", world, body)
+    assert all(aliased)
+    for d in donated:
+        assert np.array_equal(d, np.full(32, 3.0, np.float32))
+    for c in ctxs:
+        c.shutdown()

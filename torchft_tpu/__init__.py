@@ -31,6 +31,10 @@ from torchft_tpu.comm.context import (  # noqa: F401
 )
 from torchft_tpu.comm.subproc import SubprocessCommContext  # noqa: F401
 from torchft_tpu.comm.transport import TcpCommContext  # noqa: F401
+from torchft_tpu.comm.xla_backend import (  # noqa: F401
+    MeshManager,
+    XlaCommContext,
+)
 from torchft_tpu.data import DistributedSampler  # noqa: F401
 from torchft_tpu.ddp import (  # noqa: F401
     DistributedDataParallel,
@@ -67,6 +71,8 @@ __all__ = [
     "ReduceOp",
     "SubprocessCommContext",
     "TcpCommContext",
+    "XlaCommContext",
+    "MeshManager",
     "WorldSizeMode",
     "future_chain",
     "future_timeout",

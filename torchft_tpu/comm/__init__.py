@@ -16,3 +16,8 @@ from torchft_tpu.comm.context import (  # noqa: F401
 )
 from torchft_tpu.comm.transport import TcpCommContext  # noqa: F401
 from torchft_tpu.comm.subproc import SubprocessCommContext  # noqa: F401
+from torchft_tpu.comm.xla_backend import (  # noqa: F401
+    MeshManager,
+    XlaCommContext,
+    default_mesh_manager,
+)

@@ -103,9 +103,31 @@ class CommContext(ABC):
     stale rounds cannot cross-talk (ref manager.py:470-477).
     """
 
+    # Which data plane this context's collectives ride: "host" (socket
+    # transport — TcpCommContext and its subprocess proxy), "xla"
+    # (on-device jax.lax collectives, comm/xla_backend.py), or "none"
+    # (identity/test contexts that move no bytes). The Manager labels
+    # its metrics sink with this so every comm_*/outer_* series in an
+    # evidence JSON carries the backend that produced it.
+    backend_name = "none"
+
     def __init__(self) -> None:
         self._rank = 0
         self._world_size = 1
+
+    @staticmethod
+    def _prepare(a) -> np.ndarray:
+        """Donation contract: ALLREDUCE reduces in place, so the submitted
+        array must be contiguous and writable — anything else (e.g. the
+        read-only views jax.device_get can return) is copied once here;
+        caller-owned staging buffers pass through untouched and the future
+        resolves to those same arrays, reduced. ONE definition shared by
+        every data plane (host sockets and the xla backend) so donation
+        semantics can never diverge across backends."""
+        a = np.asarray(a)
+        if not (a.flags["C_CONTIGUOUS"] and a.flags["WRITEABLE"]):
+            a = np.array(a)
+        return a
 
     @abstractmethod
     def configure(self, store_addr: str, rank: int, world_size: int) -> None:
@@ -228,6 +250,10 @@ class ErrorSwallowingCommContext(CommContext):
         self._error: Optional[Exception] = None
         self._lock = threading.Lock()
 
+    @property
+    def backend_name(self) -> str:  # type: ignore[override]
+        return self._inner.backend_name
+
     def configure(self, store_addr: str, rank: int, world_size: int) -> None:
         with self._lock:
             self._error = None
@@ -312,6 +338,10 @@ class ManagedCommContext(CommContext):
     def __init__(self, manager) -> None:  # torchft_tpu.manager.Manager
         super().__init__()
         self._manager = manager
+
+    @property
+    def backend_name(self) -> str:  # type: ignore[override]
+        return self._manager.comm_backend()
 
     def configure(self, store_addr: str, rank: int, world_size: int) -> None:
         raise RuntimeError(

@@ -155,6 +155,8 @@ class _Epoch:
 class SubprocessCommContext(CommContext):
     """CommContext façade over a killable child process."""
 
+    backend_name = "host"  # the child owns a TcpCommContext — same plane
+
     def __init__(self, timeout: "float | timedelta" = 60.0,
                  algorithm: str = "auto", channels: int = 4,
                  compression: str = "none",

@@ -87,7 +87,7 @@ from torchft_tpu.utils.metrics import Metrics
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["TcpCommContext"]
+__all__ = ["TcpCommContext", "codec_roundtrip", "codec_wire_nbytes"]
 
 _OP_ALLREDUCE = 1
 _OP_ALLGATHER = 2
@@ -638,6 +638,35 @@ _CODECS = {
 _NO_CODEC = _NoCodec()
 
 
+def codec_roundtrip(codec, chunk_bytes: int, src: np.ndarray,
+                    out: np.ndarray) -> None:
+    """Write decode(encode(src)) into ``out``, chunked exactly as one
+    allreduce contribution over the grid — THE definition of the wire's
+    local image. Shared by TcpCommContext.wire_roundtrip and the
+    on-device backend (xla_backend.py), whose error-feedback path runs
+    this same numpy codec so host and device EF residuals are computed
+    against bit-identical images."""
+    copy = lambda v, inc: np.copyto(v, inc)  # noqa: E731
+    src_chunks = _chunk_grid([src.reshape(-1)], chunk_bytes)
+    out_chunks = _chunk_grid([out.reshape(-1)], chunk_bytes)
+    for ch_s, ch_o in zip(src_chunks, out_chunks):
+        codec.decode_into(
+            _iov_join(codec.encode_iovecs([ch_s])), [ch_o], copy
+        )
+
+
+def codec_wire_nbytes(codec, chunk_bytes: int, a: np.ndarray) -> int:
+    """Encoded payload size of ``a`` as one allreduce contribution: the
+    codec's per-chunk wire size summed over the same chunk grid a real
+    op would use (int8 carries a per-chunk scale header, so the grid
+    matters). Pure size arithmetic — nothing is encoded."""
+    a = np.asarray(a)
+    return sum(
+        codec.wire_nbytes(ch)
+        for ch in _chunk_grid([a.reshape(-1)], chunk_bytes)
+    )
+
+
 
 
 class _Lane:
@@ -1164,6 +1193,8 @@ class TcpCommContext(CommContext):
     """Reconfigurable collective context over TCP (star or ring wire
     topology; see class ctor)."""
 
+    backend_name = "host"
+
     def __init__(self, timeout: "float | timedelta" = 60.0,
                  algorithm: str = "auto", channels: int = 4,
                  compression: str = "none",
@@ -1230,11 +1261,15 @@ class TcpCommContext(CommContext):
         # comm_reduce_future + comm_l{i}_wire_reduce). The Manager shares
         # its own Metrics in via set_metrics so bench surfaces both.
         self.metrics = Metrics()
+        self.metrics.label("comm_backend", self.backend_name)
 
     def set_metrics(self, metrics: Metrics) -> None:
         """Record lane phase timings into ``metrics`` (call before
-        ``configure``; lanes bind it at thread start)."""
+        ``configure``; lanes bind it at thread start). The sink is
+        tagged with this context's ``comm_backend`` so host-vs-xla
+        trajectories stay distinguishable in evidence JSONs."""
         self.metrics = metrics
+        metrics.label("comm_backend", self.backend_name)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -1504,39 +1539,16 @@ class TcpCommContext(CommContext):
         if not self.wire_compensable():
             np.copyto(out, src)
             return
-        copy = lambda v, inc: np.copyto(v, inc)  # noqa: E731
-        codec = self._codec
-        src_chunks = _chunk_grid([src.reshape(-1)], self._chunk_bytes)
-        out_chunks = _chunk_grid([out.reshape(-1)], self._chunk_bytes)
-        for ch_s, ch_o in zip(src_chunks, out_chunks):
-            codec.decode_into(
-                _iov_join(codec.encode_iovecs([ch_s])), [ch_o], copy
-            )
+        codec_roundtrip(self._codec, self._chunk_bytes, src, out)
 
     def wire_nbytes(self, a: np.ndarray) -> int:
-        """Encoded payload size of ``a`` as one allreduce contribution:
-        the codec's per-chunk wire size summed over the same chunk grid
-        a real op would use (int8 carries a per-chunk scale header, so
-        the grid matters). Pure size arithmetic — nothing is encoded."""
-        a = np.asarray(a)
-        return sum(
-            self._codec.wire_nbytes(ch)
-            for ch in _chunk_grid([a.reshape(-1)], self._chunk_bytes)
-        )
+        """Encoded one-direction payload size of ``a`` over the chunk
+        grid (see module-level :func:`codec_wire_nbytes`)."""
+        return codec_wire_nbytes(self._codec, self._chunk_bytes, a)
 
     # ----------------------------------------------------------- collectives
-
-    @staticmethod
-    def _prepare(a) -> np.ndarray:
-        """Donation contract: ALLREDUCE reduces in place, so the submitted
-        array must be contiguous and writable — anything else (e.g. the
-        read-only views jax.device_get can return) is copied once here;
-        caller-owned staging buffers pass through untouched and the future
-        resolves to those same arrays, reduced."""
-        a = np.asarray(a)
-        if not (a.flags["C_CONTIGUOUS"] and a.flags["WRITEABLE"]):
-            a = np.array(a)
-        return a
+    # _prepare (the donation-contract input normalization) is inherited
+    # from CommContext — one definition for every data plane.
 
     def _submit(self, opcode: int, arrays: Sequence[np.ndarray], op: str,
                 root: int) -> Work:

@@ -1,0 +1,1117 @@
+"""On-device data plane: collectives lowered to ``jax.lax`` ops over a
+reconfigurable mesh (ROADMAP item 1, the SNIPPETS.md ProcessGroupXla
+target).
+
+The host transport (transport.py) moves gradient bytes over TCP sockets
+— the right plane for cross-host DCN traffic and the bitwise oracle for
+everything else. On real TPU hardware the fast path is ICI: collectives
+belong INSIDE a jitted computation, where XLA schedules them against
+compute. ``XlaCommContext`` implements the same ``CommContext`` surface
+(allreduce with the donation contract, broadcast, allgather, the
+``wire_*`` introspection the error-feedback arena keys off) but its
+ALLREDUCE lowers to ``jax.lax.all_gather``/``psum`` inside ``shard_map``
+over a named mesh axis, with the PR 2 chunk grid and wire codecs
+(bf16/int8 + per-chunk scales) fused into the SAME jitted computation —
+encode → exchange → decode-accumulate as one executable, the first step
+toward EQuARX-style fused quantized collectives (ROADMAP item 2).
+
+Membership churn without retrace storms
+---------------------------------------
+The perf architecture is the :class:`MeshManager`. Each
+``Manager.quorum()`` that changes the wire membership triggers
+``configure(store_addr, rank, world_size)`` exactly as for the host
+transport; here that rebuilds the ``jax.sharding.Mesh`` from the device
+pool — ALWAYS ``devices[:world_size]``, never the identity of surviving
+ranks, so every quorum at the same world size maps to the SAME mesh
+object — and swaps in a compiled executable from a cache keyed by
+``(world_size, algorithm, codec, chunk grid, op, array layouts)``. A
+replica dying therefore costs one cache lookup at the step boundary (or
+one compile on FIRST sight of that world size), never a per-step
+retrace: ``MeshManager.compile_count`` is pinned by
+tests/test_xla_backend.py. Contrast with baking the replica dimension
+into the train step itself, where every membership change recompiles
+the model.
+
+Bitwise parity with the socket transport
+----------------------------------------
+The host transport is the oracle: for a fixed chunk grid, the on-device
+allreduce reproduces the socket transport's results BIT FOR BIT, for
+every codec, in both topologies' accumulation orders —
+
+* ``star``: acc = v_0 + Σ_{r>0} dec(enc(v_r)) in rank order per chunk,
+  the root's own contribution raw, the result re-encoded once (lossy
+  codecs), exactly like ``_star_allreduce_root_chunks``.
+* ``ring``: per grid chunk, per rank-part c (``_chunk_bounds`` split),
+  partial sums accumulate uncompressed in ring order
+  v_c, then v_{c+1} + acc, ... (the reduce-scatter), and the completed
+  part is encoded ONCE (per-part scales) like the all-gather phase.
+
+Floating-point accumulation order is reproduced exactly; the remaining
+hazard is XLA itself changing rounding behavior — on CPU/TPU the
+backend contracts ``a*b + c`` into a fused multiply-add (skipping the
+product's rounding; ``lax.optimization_barrier`` does NOT stop it) and
+keeps f64→f32 converts in excess precision. Every host-rounding point
+therefore passes through :func:`_hardround`: a bitcast → XOR with a
+RUNTIME zero → bitcast identity that no compiler pass can see through,
+costing one integer op per element. int8 scales are computed via an
+f64 divide (under ``enable_x64`` at trace time only) to reproduce
+numpy's ``np.float32(absmax / 127.0)`` double-precision rounding.
+
+Single-process rendezvous
+-------------------------
+On real multi-host TPU, jax is multi-controller: every process calls
+the same jitted function and the rendezvous IS the collective. The CPU
+sandbox (``--xla_force_host_platform_device_count=N``) is single
+process, so ``_XlaGroup`` stands in for the SPMD launch: contexts
+configured against the same store prefix join one group; each rank's
+submit deposits its donated arrays, and when the full cohort has
+submitted a sequence number the group's executor runs ONE jitted
+computation over the mesh and copies each rank's result back into its
+donated buffers. Op pairing is by per-rank submission order — the same
+contract as the host transport's lanes — and a missing rank fails the
+op with ``ConnectionError`` after the timeout, which the Manager
+latches exactly like a dead socket. Broadcast/allgather carry state
+(checkpoint-adjacent, never the gradient hot path) and ride a plain
+host-side exchange inside the group.
+
+64-bit payloads (f64/i64/u64) reduce on a host-side simulation of the
+same topology/codec math (bitwise-identical by construction — it runs
+the transport's own codec code); everything the DDP/outer planes
+actually ship (f32 buckets, the f32 outer staging arena) runs on
+device.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from datetime import timedelta
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchft_tpu.comm.context import CommContext, ReduceOp, Work
+from torchft_tpu.comm.transport import (
+    _CODECS,
+    _REDUCE_FNS,
+    _Lane,
+    _NoCodec,
+    _chunk_grid,
+    _iov_join,
+    codec_roundtrip,
+    codec_wire_nbytes,
+)
+from torchft_tpu.utils.metrics import Metrics
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["XlaCommContext", "MeshManager", "default_mesh_manager"]
+
+_AXIS = "replica"
+
+# Dtypes the on-device path carries. f32 is the codec plane; the rest
+# pass through uncompressed (matching the host codecs' _is_compressible
+# gate) but still accumulate in the topology's exact order. 64-bit
+# dtypes fall back to the in-group host simulation (module docstring).
+_DEVICE_DTYPES = {
+    "<f4", "<f2", "bfloat16",
+    "|i1", "<i2", "<i4", "|u1", "<u2", "<u4",
+}
+
+
+def _dtype_key(dt: np.dtype) -> str:
+    s = np.dtype(dt).str
+    return np.dtype(dt).name if s.lstrip("<>|=").startswith("V") else s
+
+
+def _is_device_dtype(dt: np.dtype) -> bool:
+    return _dtype_key(dt) in _DEVICE_DTYPES
+
+
+# --------------------------------------------------------------- mesh plane
+
+
+class MeshManager:
+    """Mesh + compiled-executable cache across quorum epochs.
+
+    ``mesh_for(world_size)`` always builds over ``devices[:world_size]``
+    — rank r of the wire maps to pool device r regardless of WHICH
+    replicas survived, so the mesh (and every executable compiled
+    against it) is reusable for any future quorum at that world size.
+    ``executable`` returns the cached compiled computation or builds it
+    once (AOT ``lower().compile()`` so the compile is counted and paid
+    at a known point, not mid-collective on some later shape-dependent
+    call). Thread-safe; shared process-wide by default so several
+    contexts (one per Manager in a test harness) hit one cache."""
+
+    def __init__(self, devices: Optional[Sequence[Any]] = None,
+                 axis_name: str = _AXIS) -> None:
+        self._devices = tuple(devices) if devices is not None else None
+        self.axis_name = axis_name
+        self._meshes: Dict[int, Any] = {}
+        self._execs: Dict[Tuple, Any] = {}
+        self._building: Dict[Tuple, Future] = {}
+        self._lock = threading.Lock()
+        # compile_count: executables actually built (lower+compile).
+        # trace_count: times a builder's python body ran (re-traces).
+        # hit_count: cache hits. Pinned by the reconfiguration tests.
+        self.compile_count = 0
+        self.trace_count = 0
+        self.hit_count = 0
+
+    def devices(self) -> Tuple:
+        if self._devices is None:
+            import jax
+
+            self._devices = tuple(jax.devices())
+        return self._devices
+
+    def _note_trace(self) -> None:
+        # under the lock like compile_count/hit_count: trace_count is
+        # the retrace-storm regression signal — a lost increment from
+        # two concurrent first-sight builds would hide a real retrace
+        with self._lock:
+            self.trace_count += 1
+
+    def device_count(self) -> int:
+        return len(self.devices())
+
+    def mesh_for(self, world_size: int):
+        from jax.sharding import Mesh
+
+        with self._lock:
+            mesh = self._meshes.get(world_size)
+            if mesh is None:
+                devs = self.devices()
+                if world_size > len(devs):
+                    raise ValueError(
+                        f"world_size {world_size} exceeds the device pool "
+                        f"({len(devs)} devices); raise "
+                        "--xla_force_host_platform_device_count or pass a "
+                        "larger `devices` pool to MeshManager"
+                    )
+                mesh = Mesh(devs[:world_size], (self.axis_name,))
+                self._meshes[world_size] = mesh
+            return mesh
+
+    def executable(self, key: Tuple, build):
+        """Cached compiled executable for ``key``; ``build()`` runs at
+        most once per key for the life of the pool (across quorum
+        epochs — this is what makes a world-size change a cache lookup
+        instead of a retrace)."""
+        with self._lock:
+            ex = self._execs.get(key)
+            if ex is not None:
+                self.hit_count += 1
+                return ex
+            pending = self._building.get(key)
+            if pending is None:
+                pending = self._building[key] = Future()
+                owner = True
+            else:
+                owner = False
+        if not owner:
+            # Another thread is already compiling this key (two Managers
+            # sharing the default pool can race on first sight): wait for
+            # its result instead of duplicating a multi-second compile —
+            # this is what keeps compile_count exactly 1 per key.
+            ex = pending.result()
+            with self._lock:
+                self.hit_count += 1
+            return ex
+        try:
+            ex = build()  # compile outside the lock: compiles are slow
+            # and jax's own dispatch is thread-safe.
+        except Exception as e:
+            with self._lock:
+                del self._building[key]
+            pending.set_exception(e)
+            raise
+        with self._lock:
+            self._execs[key] = ex
+            self.compile_count += 1
+            del self._building[key]
+        pending.set_result(ex)
+        return ex
+
+
+_DEFAULT_MESH_MANAGER: Optional[MeshManager] = None
+_DEFAULT_MM_LOCK = threading.Lock()
+
+
+def default_mesh_manager() -> MeshManager:
+    """Process-wide MeshManager over ``jax.devices()``."""
+    global _DEFAULT_MESH_MANAGER
+    with _DEFAULT_MM_LOCK:
+        if _DEFAULT_MESH_MANAGER is None:
+            _DEFAULT_MESH_MANAGER = MeshManager()
+        return _DEFAULT_MESH_MANAGER
+
+
+# ------------------------------------------------------- traced collective
+
+
+def _hardround(x, z):
+    """Opaque identity forcing ``x`` to materialize at its own
+    precision: bitcast to the width-matched int, XOR with a RUNTIME
+    zero, bitcast back. This is the parity linchpin — XLA's backends
+    contract ``a*b + c`` into an FMA (skipping the product rounding the
+    host performed) and carry f64→f32 converts in excess precision, and
+    ``lax.optimization_barrier`` does not reliably stop either. No pass
+    can fold an XOR with a value only known at run time."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    itemsize = np.dtype(x.dtype).itemsize
+    int_dt = {1: jnp.int8, 2: jnp.int16, 4: jnp.int32, 8: jnp.int64}[itemsize]
+    zz = z.astype(int_dt) if itemsize != 4 else z
+    return lax.bitcast_convert_type(
+        lax.bitcast_convert_type(x, int_dt) ^ zz, x.dtype
+    )
+
+
+def _dev_enc_dec(codec_name: str, x, z):
+    """decode(encode(x)) for one chunk view, bit-matching the host
+    codec (transport.py) for f32 inputs; identity for dtypes the host
+    wire does not compress."""
+    import jax.numpy as jnp
+
+    if codec_name == "none" or x.dtype != jnp.float32:
+        return x
+    if codec_name == "bf16":
+        return x.astype(jnp.bfloat16).astype(jnp.float32)
+    if codec_name == "fp16":
+        return x.astype(jnp.float16).astype(jnp.float32)
+    if codec_name == "int8":
+        # numpy computes the scale as f32(f64(absmax) / 127.0); the f64
+        # divide (real, thanks to enable_x64 at trace time) plus the
+        # hardrounds reproduce it exactly — see module docstring.
+        absmax = jnp.max(jnp.abs(x))
+        scale64 = absmax.astype(jnp.float64) / np.float64(127.0)
+        scale = jnp.where(
+            absmax > 0, scale64, np.float64(1.0)
+        ).astype(jnp.float32)
+        scale = jnp.where(jnp.isfinite(absmax), scale, jnp.float32(np.nan))
+        scale = _hardround(scale, z)
+        q = jnp.clip(
+            jnp.rint(_hardround(x / scale, z)), -127, 127
+        ).astype(jnp.int8)
+        q = jnp.where(jnp.isfinite(absmax), q, jnp.int8(0))
+        return _hardround(q.astype(jnp.float32) * scale, z)
+    raise ValueError(f"unknown codec {codec_name!r}")
+
+
+def _is_float(dt) -> bool:
+    return np.dtype(dt).kind == "f" or "float" in np.dtype(dt).name
+
+
+def _build_allreduce(mesh_mgr: MeshManager, world_size: int,
+                     algorithm: str, codec_name: str, chunk_bytes: int,
+                     op: str, layouts: Sequence[Tuple[int, np.dtype]]):
+    """Compile ONE allreduce executable: inputs are a runtime int32
+    zero plus one (world, size) stacked flat array per payload array;
+    outputs mirror the stacked shape, every row carrying the identical
+    reduced value. ``layouts`` is [(flat_size, dtype), ...]."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = world_size
+    mesh = mesh_mgr.mesh_for(n)
+    axis = mesh_mgr.axis_name
+    lossy = codec_name != "none"
+
+    def bounds_of(size: int, itemsize: int) -> List[Tuple[int, int]]:
+        if size == 0:
+            return []
+        if chunk_bytes <= 0:
+            return [(0, size)]
+        step = max(1, chunk_bytes // itemsize)  # _chunk_grid's step rule
+        return [(s, min(size, s + step)) for s in range(0, size, step)]
+
+    def comb(acc, new, z):
+        # host: reduce_fn(left, incoming) writes into LEFT — star keeps
+        # the accumulator left, ring keeps the local (newer) value left.
+        if op in (ReduceOp.SUM, ReduceOp.AVG):
+            out = acc + new
+            return _hardround(out, z) if _is_float(out.dtype) else out
+        if op == ReduceOp.MAX:
+            return jnp.maximum(acc, new)
+        if op == ReduceOp.MIN:
+            return jnp.minimum(acc, new)
+        raise ValueError(f"unsupported reduce op: {op}")
+
+    def reduce_chunk_star(g, s, e, z):
+        acc = g[0, s:e]
+        for r in range(1, n):
+            acc = comb(acc, _dev_enc_dec(codec_name, g[r, s:e], z), z)
+        if op == ReduceOp.AVG:
+            acc = acc / jnp.float32(n)
+            acc = _hardround(acc, z) if _is_float(acc.dtype) else acc
+        if lossy:
+            acc = _dev_enc_dec(codec_name, acc, z)
+        return acc
+
+    def reduce_chunk_ring(g, s, e, z):
+        # per rank-part accumulation in ring order; completed parts are
+        # encoded once each (per-part scales), AVG divides post-decode —
+        # _ring_allreduce_chunks semantics exactly.
+        sub = []
+        for c in range(n):
+            ps, pe = _Lane._chunk_bounds(e - s, n, c)
+            if ps == pe:
+                continue
+            acc = g[c % n, s + ps: s + pe]
+            for i in range(1, n):
+                acc = comb(g[(c + i) % n, s + ps: s + pe], acc, z)
+            if lossy:
+                acc = _dev_enc_dec(codec_name, acc, z)
+            if op == ReduceOp.AVG:
+                acc = acc / jnp.float32(n)
+                acc = _hardround(acc, z) if _is_float(acc.dtype) else acc
+            sub.append(acc)
+        return jnp.concatenate(sub) if len(sub) > 1 else sub[0]
+
+    def fn(z, *stacked):
+        def local(z, *rows):
+            outs = []
+            for row, (size, dt) in zip(rows, layouts):
+                if algorithm == "psum":
+                    if op in (ReduceOp.SUM, ReduceOp.AVG):
+                        red = jax.lax.psum(row[0], axis)
+                        if op == ReduceOp.AVG:
+                            red = red / jnp.float32(n)
+                    elif op == ReduceOp.MAX:
+                        red = jax.lax.pmax(row[0], axis)
+                    else:
+                        red = jax.lax.pmin(row[0], axis)
+                    outs.append(jnp.expand_dims(red, 0))
+                    continue
+                # all_gather only on the oracle paths — the psum branch
+                # above must not depend on DCE to avoid shipping it
+                g = jax.lax.all_gather(row[0], axis)
+                reduce_chunk = (
+                    reduce_chunk_star if algorithm == "star"
+                    else reduce_chunk_ring
+                )
+                parts = [
+                    reduce_chunk(g, s, e, z)
+                    for (s, e) in bounds_of(size, np.dtype(dt).itemsize)
+                ]
+                out = (
+                    jnp.concatenate(parts) if len(parts) > 1
+                    else parts[0] if parts
+                    else jnp.zeros((0,), dt)
+                )
+                outs.append(jnp.expand_dims(out, 0))
+            return tuple(outs)
+
+        mesh_mgr._note_trace()  # python body runs once per trace
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(),) + tuple(P(axis) for _ in stacked),
+            out_specs=tuple(P(axis) for _ in stacked),
+            check_rep=False,
+        )(z, *stacked)
+
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P(axis))
+    avals = [jax.ShapeDtypeStruct((), np.int32, sharding=rep)] + [
+        jax.ShapeDtypeStruct((n, size), np.dtype(dt), sharding=row)
+        for (size, dt) in layouts
+    ]
+    with _x64_trace():
+        return jax.jit(fn).lower(*avals).compile(), (rep, row)
+
+
+def _x64_trace():
+    """x64 enabled for TRACE/LOWER time only (the int8 scale's f64
+    divide); runtime execution is config-independent."""
+    from jax.experimental import enable_x64
+
+    return enable_x64(True)
+
+
+# ------------------------------------------------------ host-side fallback
+
+
+def _host_allreduce(contribs: List[List[np.ndarray]], algorithm: str,
+                    codec_name: str, chunk_bytes: int,
+                    op: str) -> List[List[np.ndarray]]:
+    """In-group host simulation of the transport's star/ring math for
+    payload dtypes the device plane cannot hold (64-bit). Runs the REAL
+    codec code over the real chunk grid, so it is bitwise-identical to
+    the socket transport by construction. Returns per-rank results."""
+    n = len(contribs)
+    codec = _CODECS[codec_name]()
+    reduce_fn = _REDUCE_FNS.get(ReduceOp.SUM if op == ReduceOp.AVG else op)
+    if reduce_fn is None:
+        raise ValueError(f"unsupported reduce op: {op}")
+    lossy = type(codec) is not _NoCodec
+    copy = lambda v, inc: np.copyto(v, inc)  # noqa: E731
+
+    if algorithm == "star":
+        acc = [a.copy() for a in contribs[0]]
+        acc_chunks = _chunk_grid([a.reshape(-1) for a in acc], chunk_bytes)
+        peer_chunks = [
+            _chunk_grid([a.reshape(-1) for a in contribs[r]], chunk_bytes)
+            for r in range(1, n)
+        ]
+        for ci, ch in enumerate(acc_chunks):
+            for pi in range(n - 1):
+                enc = codec.encode_iovecs([peer_chunks[pi][ci]])
+                codec.decode_into(_iov_join(enc), [ch], reduce_fn)
+            if op == ReduceOp.AVG:
+                np.divide(ch, n, out=ch)
+            if lossy:
+                enc = codec.encode_iovecs([ch])
+                codec.decode_into(_iov_join(enc), [ch], copy)
+        return [acc for _ in range(n)]
+
+    # ring: simulate every rank's reduce-scatter + encode-once all-gather
+    ranks = [[a.copy() for a in contribs[r]] for r in range(n)]
+    flats = [
+        _chunk_grid([a.reshape(-1) for a in ranks[r]], chunk_bytes)
+        for r in range(n)
+    ]
+
+    def views(r: int, c: int) -> List[np.ndarray]:
+        out = []
+        for f in flats[r]:
+            s, e = _Lane._chunk_bounds(f.size, n, c)
+            out.append(f[s:e])
+        return out
+
+    for step in range(n - 1):
+        sent = {
+            r: [v.copy() for v in views(r, (r - step) % n)] for r in range(n)
+        }
+        for r in range(n):
+            for v, inc in zip(views(r, (r - step - 1) % n), sent[(r - 1) % n]):
+                reduce_fn(v, inc)
+    for c in range(n):
+        enc = _iov_join(codec.encode_iovecs(views((c - 1) % n, c)))
+        for r in range(n):
+            codec.decode_into(enc, views(r, c), copy)
+    if op == ReduceOp.AVG:
+        for r in range(n):
+            for f in flats[r]:
+                np.divide(f, n, out=f)
+    return ranks
+
+
+# ---------------------------------------------------------- group rendezvous
+
+
+class _Sub:
+    __slots__ = ("opcode", "arrays", "op", "root", "fut", "t_submit")
+
+    def __init__(self, opcode: str, arrays: List[np.ndarray], op: str,
+                 root: int, fut: Future) -> None:
+        self.opcode = opcode
+        self.arrays = arrays
+        self.op = op
+        self.root = root
+        self.fut = fut
+        self.t_submit = time.perf_counter()
+
+
+class _XlaGroup:
+    """In-process rendezvous standing in for the SPMD launch (module
+    docstring): one group per store prefix, executing each fully-
+    subscribed op on a 1-thread executor so submits stay O(enqueue)."""
+
+    _registry: Dict[str, "_XlaGroup"] = {}
+    _registry_lock = threading.Lock()
+
+    @classmethod
+    def join(cls, key: str, rank: int, world_size: int,
+             ctx: "XlaCommContext", timeout: float) -> "_XlaGroup":
+        with cls._registry_lock:
+            group = cls._registry.get(key)
+            if group is None:
+                group = cls(key, world_size, ctx._mesh_mgr)
+                cls._registry[key] = group
+        group._add_member(rank, world_size, ctx)
+        # Block until the full cohort arrives — the host transport's
+        # configure blocks on socket rendezvous the same way, and a
+        # peer that died pre-rendezvous must fail configure, not the
+        # first collective.
+        deadline = time.time() + timeout
+        try:
+            with group._cond:
+                while (len(group._members) < world_size
+                       and not group._closed):
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"xla comm configure: {len(group._members)} of "
+                            f"{world_size} ranks joined {key!r} before "
+                            "timeout"
+                        )
+                    group._cond.wait(timeout=min(0.1, remaining))
+                if group._closed:
+                    raise ConnectionError(
+                        f"xla comm configure: group {key!r} closed during "
+                        "rendezvous (a member reconfigured or shut down)"
+                    )
+        except Exception:
+            group._abandon(rank)
+            raise
+        return group
+
+    def __init__(self, key: str, world_size: int,
+                 mesh_mgr: MeshManager) -> None:
+        self.key = key
+        self.world_size = world_size
+        self.mesh_mgr = mesh_mgr
+        self._members: Dict[int, "XlaCommContext"] = {}
+        self._pending: Dict[int, Dict[int, _Sub]] = {}
+        self._timers: Dict[int, threading.Timer] = {}
+        self._cond = threading.Condition()
+        self._closed = False
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"torchft_tpu_xla_{id(self)}"
+        )
+
+    def _add_member(self, rank: int, world_size: int,
+                    ctx: "XlaCommContext") -> None:
+        with self._cond:
+            if self._closed:
+                raise ConnectionError(
+                    f"xla comm configure: group {self.key!r} already closed"
+                )
+            if world_size != self.world_size:
+                raise ValueError(
+                    f"xla comm configure: rank {rank} joined {self.key!r} "
+                    f"with world_size {world_size}, group has "
+                    f"{self.world_size}"
+                )
+            if rank in self._members:
+                raise ValueError(
+                    f"xla comm configure: duplicate rank {rank} in "
+                    f"{self.key!r}"
+                )
+            first = next(iter(self._members.values()), None)
+            if first is None:
+                # The FIRST MEMBER's pool owns the group's executables:
+                # the creating context can lose the join race to a
+                # mismatched peer and never become a member, and
+                # collectives must never run (nor count compiles) on a
+                # pool no member passed in.
+                self.mesh_mgr = ctx._mesh_mgr
+            else:
+                mine = (ctx._codec_name, ctx._chunk_bytes, ctx._algorithm)
+                theirs = (first._codec_name, first._chunk_bytes,
+                          first._algorithm)
+                if mine != theirs or ctx._mesh_mgr is not self.mesh_mgr:
+                    raise ValueError(
+                        f"xla comm configure: rank {rank} joined "
+                        f"{self.key!r} with (codec, chunk_bytes, "
+                        f"algorithm)={mine} but the group runs {theirs} "
+                        "(settings and mesh_manager must match across "
+                        "ranks, like the host transport's)"
+                    )
+            self._members[rank] = ctx
+            self._cond.notify_all()
+
+    def _abandon(self, rank: int) -> None:
+        """Failed rendezvous: deregister the waiting rank so a retried
+        configure on the same store address re-attempts the rendezvous
+        instead of failing on 'duplicate rank'; the last member to give
+        up disposes the group (still-waiting peers keep it alive — a
+        retry can complete their rendezvous)."""
+        with self._cond:
+            self._members.pop(rank, None)
+            dispose = not self._members and not self._closed
+            if dispose:
+                # Mark closed BEFORE dropping from the registry: a racing
+                # joiner that fetched this group object must fail fast in
+                # _add_member, not wait out its timeout on a zombie.
+                self._close_locked(ConnectionError(
+                    f"xla comm group {self.key!r} disposed after a "
+                    "failed rendezvous"
+                ))
+            self._cond.notify_all()
+        if dispose:
+            with self._registry_lock:
+                if self._registry.get(self.key) is self:
+                    del self._registry[self.key]
+            self._executor.shutdown(wait=False)
+
+    def leave(self, ctx: "XlaCommContext") -> None:
+        """A member reconfiguring/shutting down closes the whole group —
+        the analog of the host transport closing its sockets: peers'
+        in-flight and future ops on the stale round must fail fast."""
+        with self._cond:
+            if ctx not in self._members.values():
+                return
+            self._close_locked(
+                ConnectionError(
+                    f"xla comm group {self.key!r} torn down "
+                    "(member reconfigured or shut down)"
+                )
+            )
+        with self._registry_lock:
+            if self._registry.get(self.key) is self:
+                del self._registry[self.key]
+        self._executor.shutdown(wait=False)
+
+    def _close_locked(self, exc: Exception) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for timer in self._timers.values():
+            timer.cancel()
+        self._timers.clear()
+        pend, self._pending = self._pending, {}
+        for subs in pend.values():
+            for sub in subs.values():
+                try:
+                    sub.fut.set_exception(exc)
+                except Exception:  # noqa: BLE001 — already resolved
+                    pass
+        self._cond.notify_all()
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, rank: int, seq: int, sub: _Sub,
+               timeout: float) -> None:
+        run_now = None
+        with self._cond:
+            if self._closed:
+                sub.fut.set_exception(ConnectionError(
+                    f"xla comm group {self.key!r} is closed"
+                ))
+                return
+            subs = self._pending.setdefault(seq, {})
+            subs[rank] = sub
+            if len(subs) == self.world_size:
+                del self._pending[seq]
+                timer = self._timers.pop(seq, None)
+                if timer is not None:
+                    timer.cancel()
+                run_now = subs
+            elif seq not in self._timers:
+                # First arrival arms the straggler deadline: a peer that
+                # died mid-step must fail the survivors' op (which the
+                # Manager latches) rather than hang them.
+                timer = threading.Timer(
+                    timeout, self._expire, args=(seq,)
+                )
+                timer.daemon = True
+                self._timers[seq] = timer
+                timer.start()
+        if run_now is not None:
+            # Enqueue only — completion order across seqs is monotonic
+            # (each rank submits in program order), so the 1-thread
+            # executor preserves the per-group op sequence.
+            try:
+                self._executor.submit(self._execute_safe, seq, run_now)
+            except RuntimeError as e:
+                # A member tore the group down between our lock release
+                # and the enqueue: this seq already left _pending (so
+                # _close_locked could not fail it) and its watchdog is
+                # cancelled — fail every rank's future here or the
+                # survivors block in .result() forever.
+                exc = ConnectionError(
+                    f"xla comm group {self.key!r} closed while "
+                    f"dispatching seq={seq}: {e}"
+                )
+                for sub in run_now.values():
+                    try:
+                        sub.fut.set_exception(exc)
+                    except Exception:  # noqa: BLE001
+                        pass
+                for ctx in list(self._members.values()):
+                    ctx._latch_group_error(self, exc)
+
+    def _expire(self, seq: int) -> None:
+        with self._cond:
+            subs = self._pending.pop(seq, None)
+            self._timers.pop(seq, None)
+        if not subs:
+            return
+        missing = sorted(set(range(self.world_size)) - set(subs))
+        exc = ConnectionError(
+            f"xla comm op seq={seq} timed out waiting for ranks {missing} "
+            f"in group {self.key!r}"
+        )
+        for sub in subs.values():
+            try:
+                sub.fut.set_exception(exc)
+            except Exception:  # noqa: BLE001
+                pass
+        for ctx in list(self._members.values()):
+            ctx._latch_group_error(self, exc)
+
+    # ------------------------------------------------------------ execute
+
+    def _execute_safe(self, seq: int, subs: Dict[int, _Sub]) -> None:
+        try:
+            self._execute(seq, subs)
+        except Exception as e:  # noqa: BLE001 — fail the op, latch all
+            logger.warning(
+                "xla comm op failed (group %s seq %d): %s",
+                self.key, seq, e,
+            )
+            for sub in subs.values():
+                try:
+                    sub.fut.set_exception(e)
+                except Exception:  # noqa: BLE001
+                    pass
+            for ctx in list(self._members.values()):
+                ctx._latch_group_error(self, e)
+
+    def _execute(self, seq: int, subs: Dict[int, _Sub]) -> None:
+        n = self.world_size
+        ordered = [subs[r] for r in range(n)]
+        first = ordered[0]
+        sig = [
+            (sub.opcode, sub.op, sub.root,
+             [(a.shape, _dtype_key(a.dtype)) for a in sub.arrays])
+            for sub in ordered
+        ]
+        if first.opcode in ("broadcast", "allgather"):
+            # layouts may legally differ per rank: broadcast discards
+            # non-root contributions, allgather self-describes each
+            # rank's arrays (host-plane semantics — variable-length
+            # state is the normal allgather use)
+            sig = [s[:3] for s in sig]
+        if any(s != sig[0] for s in sig):
+            raise ConnectionError(
+                f"xla comm collective mismatch at seq={seq}: ranks "
+                "submitted divergent ops/layouts"
+            )
+        # Per-rank spans land in each member's OWN sink (each Manager
+        # shares its Metrics in via set_metrics), same as the host
+        # transport's lanes — a host-vs-xla A/B compares like with like.
+        # ALLREDUCE ONLY, matching the host plane: a heal broadcast or
+        # state allgather landing in comm_* would pin gradient-path
+        # regressions on checkpoint traffic.
+        sinks = [self._members[r].metrics for r in range(n)]
+        t_exec = time.perf_counter()
+
+        if first.opcode == "allreduce":
+            for sub, m in zip(ordered, sinks):
+                m.observe("comm_submit_wire", t_exec - sub.t_submit)
+            self._execute_allreduce(ordered)
+            # Spans observed BEFORE the futures resolve: a caller that
+            # snapshots metrics right after .result() must see them
+            # (the smoke gate does exactly that).
+            t_done = time.perf_counter()
+            for sub, m in zip(ordered, sinks):
+                m.observe("comm_wire_reduce", t_done - t_exec)
+                m.observe("comm_op_wire", t_done - sub.t_submit)
+            for sub in ordered:
+                sub.fut.set_result(sub.arrays)
+        elif first.opcode == "broadcast":
+            src = ordered[first.root].arrays
+            for r, sub in enumerate(ordered):
+                sub.fut.set_result([np.array(a, copy=True) for a in src])
+        else:  # allgather
+            # fresh buffers PER RECEIVING RANK (the host plane decodes
+            # into per-rank buffers): a rank mutating its result in
+            # place must not be visible in a peer's
+            for sub in ordered:
+                sub.fut.set_result([
+                    [np.array(a, copy=True) for a in src.arrays]
+                    for src in ordered
+                ])
+
+    def _execute_allreduce(self, ordered: List[_Sub]) -> None:
+        import jax
+
+        n = self.world_size
+        op = ordered[0].op
+        ctx0 = self._members[0]
+        algorithm = ctx0._resolved_algorithm(n)
+        codec_name = ctx0._codec_name
+        chunk_bytes = ctx0._chunk_bytes
+        arrays0 = ordered[0].arrays
+        if op == ReduceOp.AVG and not all(
+            _is_float(a.dtype) for a in arrays0
+        ):
+            # The host plane's integer divide raises (np.divide into an
+            # int chunk is an invalid cast); the device path would
+            # silently promote-and-truncate — fail alike instead.
+            raise TypeError(
+                "ReduceOp.AVG requires float arrays (matching the host "
+                "transport, whose in-place integer divide raises)"
+            )
+
+        dev_idx = [
+            j for j, a in enumerate(arrays0) if _is_device_dtype(a.dtype)
+        ]
+        host_idx = [
+            j for j in range(len(arrays0)) if j not in dev_idx
+        ]
+
+        if host_idx:
+            host_results = _host_allreduce(
+                [[sub.arrays[j] for j in host_idx] for sub in ordered],
+                algorithm, codec_name, chunk_bytes, op,
+            )
+        outs: List[Any] = []
+        if dev_idx:
+            layouts = tuple(
+                (int(arrays0[j].size), _dtype_key(arrays0[j].dtype))
+                for j in dev_idx
+            )
+            key = (n, algorithm, codec_name, chunk_bytes, op, layouts)
+            mm = self.mesh_mgr
+            compiled, (rep, row) = mm.executable(
+                key,
+                lambda: _build_allreduce(
+                    mm, n, algorithm, codec_name, chunk_bytes, op,
+                    [(s, np.dtype(d)) for (s, d) in layouts],
+                ),
+            )
+            n_chunks = float(sum(
+                len(_chunk_grid([arrays0[j].reshape(-1)], chunk_bytes))
+                for j in dev_idx
+            ))
+            for r in range(n):
+                self._members[r].metrics.incr("comm_chunks", n_chunks)
+            with _x64_trace():
+                ins = [jax.device_put(np.int32(0), rep)] + [
+                    jax.device_put(
+                        np.stack([
+                            np.ascontiguousarray(sub.arrays[j]).reshape(-1)
+                            for sub in ordered
+                        ]),
+                        row,
+                    )
+                    for j in dev_idx
+                ]
+            outs = [np.asarray(o) for o in compiled(*ins)]
+
+        # Donation contract: copy the reduced values back into every
+        # rank's submitted arrays — callers (the DDP staging arena) rely
+        # on the result aliasing what they submitted. The caller
+        # (_execute) resolves the futures after observing the op spans.
+        for r, sub in enumerate(ordered):
+            for k, j in enumerate(dev_idx):
+                a = sub.arrays[j]
+                np.copyto(a.reshape(-1), outs[k][0].astype(a.dtype,
+                                                           copy=False))
+            for k, j in enumerate(host_idx):
+                np.copyto(sub.arrays[j], host_results[r][k])
+
+
+# --------------------------------------------------------------- the context
+
+
+class XlaCommContext(CommContext):
+    """Reconfigurable on-device collective context (module docstring).
+
+    ``algorithm``: "star"/"ring" reproduce the socket transport's
+    accumulation order and codec bits exactly (the bitwise-oracle
+    modes; "auto" picks ring at world_size >= 3 like the host), "psum"
+    lowers straight to ``jax.lax.psum`` — the hardware-native fast path
+    whose reduction order is XLA's to choose (codec must be "none").
+
+    ``compression``/``chunk_bytes`` mirror TcpCommContext: same codecs,
+    same chunk grid (also the int8 scale granularity), must match the
+    host transport's settings for A/B parity.
+
+    ``mesh_manager``: the mesh + executable cache, shared process-wide
+    by default; pass a private pool to isolate devices or pin compile
+    counters in tests."""
+
+    backend_name = "xla"
+
+    def __init__(self, timeout: "float | timedelta" = 60.0,
+                 algorithm: str = "auto",
+                 compression: str = "none",
+                 chunk_bytes: int = 1 << 20,
+                 mesh_manager: Optional[MeshManager] = None) -> None:
+        super().__init__()
+        if isinstance(timeout, timedelta):
+            timeout = timeout.total_seconds()
+        if algorithm not in ("auto", "star", "ring", "psum"):
+            raise ValueError(f"unknown algorithm {algorithm!r}")
+        if compression not in _CODECS:
+            raise ValueError(
+                f"unknown compression {compression!r}; have "
+                f"{sorted(_CODECS)}"
+            )
+        if algorithm == "psum" and compression != "none":
+            raise ValueError(
+                "algorithm='psum' lowers to a raw jax.lax.psum and "
+                "cannot carry a wire codec; use 'star'/'ring' (the "
+                "fused encode-exchange-decode paths) with "
+                f"compression={compression!r}"
+            )
+        if chunk_bytes < 0:
+            raise ValueError("chunk_bytes must be >= 0")
+        self._timeout = float(timeout)
+        self._algorithm = algorithm
+        self._codec_name = compression
+        self._codec = _CODECS[compression]()
+        self._chunk_bytes = int(chunk_bytes)
+        self._mesh_mgr = mesh_manager or default_mesh_manager()
+        self._group: Optional[_XlaGroup] = None
+        self._seq = 0
+        self._generation = 0
+        self._error: Optional[Exception] = None
+        self._lock = threading.Lock()
+        self.metrics = Metrics()
+        self.metrics.label("comm_backend", self.backend_name)
+
+    def set_metrics(self, metrics: Metrics) -> None:
+        """Share the Manager's sink (same contract as TcpCommContext);
+        per-op spans land under the host transport's span names so a
+        host-vs-xla A/B compares identical keys, distinguished by the
+        ``comm_backend`` label."""
+        self.metrics = metrics
+        metrics.label("comm_backend", self.backend_name)
+
+    def _resolved_algorithm(self, world_size: int) -> str:
+        if self._algorithm == "auto":
+            return "ring" if world_size >= 3 else "star"
+        return self._algorithm
+
+    # ------------------------------------------------------------ lifecycle
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self.shutdown()
+        with self._lock:
+            self._generation += 1
+            self._rank = rank
+            self._world_size = world_size
+            self._error = None
+            self._seq = 0
+        if world_size == 1:
+            return  # solo: every op is an identity, no group needed
+        # The store address is the cohort-shared rendezvous namespace,
+        # exactly as for the host transport: every member of a transport
+        # cohort passes the SAME full address (the Manager's trailing
+        # segment is the intra-replica rank, identical across the
+        # cohort's replica groups — stripping it would merge the
+        # per-intra-rank cohorts of a multi-rank replica group into one
+        # colliding group). Building the mesh happens here — the
+        # step-boundary reconfiguration the quorum drives — and is a
+        # cache lookup for any previously-seen world size.
+        key = store_addr
+        self._mesh_mgr.mesh_for(world_size)
+        group = _XlaGroup.join(key, rank, world_size, self, self._timeout)
+        with self._lock:
+            self._group = group
+
+    def shutdown(self) -> None:
+        with self._lock:
+            group, self._group = self._group, None
+        if group is not None:
+            group.leave(self)
+
+    def errored(self) -> Optional[Exception]:
+        with self._lock:
+            return self._error
+
+    def _latch_error(self, e: Exception) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = e
+
+    def _latch_group_error(self, group: "_XlaGroup", e: Exception) -> None:
+        """Latch only while this context still belongs to ``group``: a
+        stale group's straggler timer or executor firing after the
+        context reconfigured into a new quorum epoch must not poison
+        the healthy epoch's first op."""
+        with self._lock:
+            if self._group is group and self._error is None:
+                self._error = e
+
+    # ------------------------------------------------- wire introspection
+
+    def wire_codec_name(self) -> str:
+        return self._codec_name
+
+    def wire_is_lossy(self) -> bool:
+        return self._codec_name != "none"
+
+    def wire_generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def wire_compensable(self) -> bool:
+        """Same role-aware rule as the host transport: only a star
+        PEER's contribution crosses the (emulated) wire through the
+        lossy codec — the root's stays raw and ring partial sums ride
+        uncompressed (psum carries no codec at all)."""
+        with self._lock:
+            world = self._world_size
+            rank = self._rank
+        return (
+            self._codec_name != "none"
+            and world > 1
+            and self._resolved_algorithm(world) == "star"
+            and rank != 0
+        )
+
+    def wire_roundtrip(self, src: np.ndarray, out: np.ndarray) -> None:
+        """The host codec IS the device codec bit for bit (pinned by
+        tests/test_xla_backend.py), so the error-feedback arena's
+        roundtrip runs the cheap numpy implementation — no device
+        dispatch on the EF path."""
+        if src.shape != out.shape or src.dtype != out.dtype:
+            raise ValueError("wire_roundtrip: src/out layout mismatch")
+        if not self.wire_compensable():
+            np.copyto(out, src)
+            return
+        codec_roundtrip(self._codec, self._chunk_bytes, src, out)
+
+    def wire_nbytes(self, a: np.ndarray) -> int:
+        return codec_wire_nbytes(self._codec, self._chunk_bytes, a)
+
+    # ----------------------------------------------------------- collectives
+    # _prepare (the donation-contract input normalization) is inherited
+    # from CommContext — one definition for every data plane.
+
+    def _submit(self, opcode: str, arrays: Sequence[np.ndarray], op: str,
+                root: int) -> Work:
+        fut: Future = Future()
+        fut.set_running_or_notify_cancel()
+        err = self.errored()
+        if err is not None:
+            fut.set_exception(
+                ConnectionError(f"comm context previously errored: {err}")
+            )
+            return Work(fut)
+        prepared = [self._prepare(a) for a in arrays]
+        with self._lock:
+            world = self._world_size
+            group = self._group
+            if world > 1 and group is None:
+                fut.set_exception(
+                    RuntimeError("comm context not configured")
+                )
+                return Work(fut)
+            self._seq += 1
+            seq = self._seq
+        if world == 1:
+            if opcode == "allgather":
+                fut.set_result([prepared])
+            else:
+                fut.set_result(prepared)
+            return Work(fut)
+        group.submit(
+            self._rank, seq,
+            _Sub(opcode, prepared, op, root, fut), self._timeout,
+        )
+        return Work(fut)
+
+    def allreduce(
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
+    ) -> Work:
+        return self._submit("allreduce", arrays, op, 0)
+
+    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
+        return self._submit("allgather", arrays, ReduceOp.SUM, 0)
+
+    def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
+        return self._submit("broadcast", arrays, ReduceOp.SUM, root)

@@ -43,7 +43,15 @@ bucket per arena): D2H copies land into the arena, the transport reads
 from it and reduces into it in place (the comm-layer donation contract),
 and the result leaves are views of it until the H2D copy — no per-step
 bucket-sized allocation, no transport-side payload copies
-(docs/architecture.md, "Step pipeline"). There are ``staging_arenas``
+(docs/architecture.md, "Step pipeline"). The submit path is
+DATA-PLANE AGNOSTIC: buckets go through ``manager.allreduce_arrays``
+against whatever ``comm_backend`` the Manager was built with — "host"
+(socket transport) or "xla" (on-device ``jax.lax`` collectives,
+comm/xla_backend.py) — because both honor the same donation contract
+(the reduced values are written back into the submitted staging arena
+and the future resolves with those same arrays) and the same ``wire_*``
+introspection the EF arena keys off, with bit-identical codecs
+(tests/test_xla_backend.py pins full-step parity). There are ``staging_arenas``
 (default 2) arena GENERATIONS: a second ``average_gradients_async`` may
 pack into a fresh arena while the previous step's buckets are still on
 the wire — cross-step comm/compute overlap — and the corruption guard

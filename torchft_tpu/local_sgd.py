@@ -40,8 +40,13 @@ At its boundary a fragment
    transport's wire codec (bf16/int8 — the PR 2 ``wire_roundtrip``/EF
    machinery; residuals reset on every transport incarnation, and EF is
    role-aware via ``wire_compensable`` exactly like the DDP arena),
-3. rides the multi-lane transport as a NON-blocking op while the inner
-   loop keeps stepping, and
+3. rides the comm data plane as a NON-blocking op while the inner
+   loop keeps stepping — backend-agnostic: the fragment arena goes
+   through ``manager.allreduce_arrays`` under the donation contract,
+   which the host socket transport and the on-device xla backend
+   (comm/xla_backend.py) implement identically, with bit-identical
+   wire codecs (a full outer round over ``comm_backend="xla"`` matches
+   the host plane exactly; tests/test_xla_backend.py) — and
 4. lands its outer update (per-fragment outer optax state —
    ``optim.PartitionedOuterOptimizer``) on a bounded worker the moment
    its wire future resolves — while later fragments are still riding

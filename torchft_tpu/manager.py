@@ -81,6 +81,31 @@ def _seconds(t: "float | timedelta") -> float:
     return t.total_seconds() if isinstance(t, timedelta) else float(t)
 
 
+_REQUIRED: Any = object()  # sentinel: required param after a defaulted one
+
+
+def _build_comm_context(
+    backend: str, options: "Optional[Dict[str, Any]]", timeout: float
+) -> CommContext:
+    """Manager's ``comm_backend`` selector: construct the gradient data
+    plane by name. Lazy imports keep manager.py importable without jax
+    (the xla backend imports jax only at first collective anyway)."""
+    options = dict(options or {})
+    options.setdefault("timeout", timeout)
+    if backend == "host":
+        from torchft_tpu.comm.transport import TcpCommContext
+
+        return TcpCommContext(**options)
+    if backend == "xla":
+        from torchft_tpu.comm.xla_backend import XlaCommContext
+
+        return XlaCommContext(**options)
+    raise ValueError(
+        f"unknown comm_backend {backend!r}; have 'host' (socket "
+        "transport) and 'xla' (on-device jax.lax collectives)"
+    )
+
+
 class WorldSizeMode(Enum):
     """Numerics policy when more than ``min_replica_size`` replicas are
     healthy (ref manager.py:55-70).
@@ -106,10 +131,10 @@ class Manager:
 
     def __init__(
         self,
-        comm: CommContext,
-        load_state_dict: Optional[Callable[[T], None]],
-        state_dict: Optional[Callable[[], T]],
-        min_replica_size: int,
+        comm: Optional[CommContext] = None,
+        load_state_dict: Optional[Callable[[T], None]] = None,
+        state_dict: Optional[Callable[[], T]] = None,
+        min_replica_size: int = _REQUIRED,  # type: ignore[assignment]
         use_async_quorum: bool = True,
         timeout: "float | timedelta" = 60.0,
         quorum_timeout: "float | timedelta" = 60.0,
@@ -125,7 +150,58 @@ class Manager:
         heartbeat_interval: "float | timedelta" = 0.1,
         checkpoint_transport: Optional[CheckpointTransport] = None,
         data_plane: bool = True,
+        comm_backend: Optional[str] = None,
+        comm_options: Optional[Dict[str, Any]] = None,
     ) -> None:
+        # min_replica_size stays effectively REQUIRED even though comm's
+        # new default forced a syntactic default onto everything after
+        # it: a silently-defaulted quorum floor of 1 would let every
+        # partition-isolated replica keep committing — the split-brain
+        # this knob exists to prevent.
+        if min_replica_size is _REQUIRED:
+            raise TypeError(
+                "Manager() missing required argument: 'min_replica_size' "
+                "(the quorum floor; there is no safe default)"
+            )
+        # ``comm_backend`` selects the gradient data plane when no
+        # explicit context is passed: "host" (TcpCommContext — sockets
+        # over DCN, the cross-host plane and bitwise oracle) or "xla"
+        # (XlaCommContext — jax.lax collectives over a reconfigurable
+        # device mesh, comm/xla_backend.py). ``comm_options`` forwards
+        # ctor kwargs (compression, chunk_bytes, algorithm, ...) to the
+        # built context. Passing BOTH ``comm`` and ``comm_backend``
+        # asserts they agree — a mesh-capable caller must not silently
+        # get sockets.
+        if comm is None:
+            comm = _build_comm_context(
+                comm_backend or "host", comm_options, _seconds(timeout)
+            )
+        else:
+            if comm_options is not None:
+                raise ValueError(
+                    "comm_options applies only when the Manager builds "
+                    "the context; pass the options to your own comm ctor"
+                )
+            actual = getattr(comm, "backend_name", None)
+            if (
+                comm_backend is not None
+                and actual is not None
+                and actual != comm_backend
+            ):
+                raise ValueError(
+                    f"comm_backend={comm_backend!r} but the provided comm "
+                    f"context is backend {actual!r}"
+                )
+        # state_dict/load_state_dict come as a pair: a healable Manager
+        # needs both, stateless test/bench managers pass neither. Only
+        # one of the two is a construction bug that would otherwise
+        # surface as an assert mid-heal, long after the mistake.
+        if (load_state_dict is None) != (state_dict is None):
+            raise ValueError(
+                "load_state_dict and state_dict must be provided "
+                "together (or both omitted for a manager that never "
+                "serves or receives a heal)"
+            )
         self._load_state_dict = load_state_dict
         self._user_state_dict = state_dict
         self._pending_state_dict: Optional[Dict[str, Any]] = None
@@ -254,6 +330,11 @@ class Manager:
         # wall time went, and one reset_timings() bounds a measurement
         # window for every layer at once (bench.py relies on this).
         self.metrics = Metrics()
+        # Every span/gauge in this sink carries the active data-plane
+        # backend as a label, so a host-vs-xla A/B's evidence JSONs are
+        # distinguishable by inspection (contexts with set_metrics
+        # re-assert it; this covers identity/test contexts too).
+        self.metrics.label("comm_backend", self.comm_backend())
         # Share our metrics sink with the transport so its per-lane phase
         # timers (comm_submit_wire / comm_wire_reduce / comm_reduce_future)
         # land next to quorum/commit_barrier/allreduce in one snapshot.
@@ -874,6 +955,12 @@ class Manager:
     # membership change — is the signal to RESET them (a residual
     # describes quantization error already "owed" to a specific cohort;
     # carrying it into a new quorum would inject stale error).
+
+    def comm_backend(self) -> str:
+        """Name of the active gradient data plane ("host" sockets, "xla"
+        on-device collectives, "none" for identity/test contexts) — the
+        label every metric span in ``self.metrics`` is tagged with."""
+        return str(getattr(self._comm, "backend_name", "none"))
 
     def wire_codec_name(self) -> str:
         fn = getattr(self._comm, "wire_codec_name", None)

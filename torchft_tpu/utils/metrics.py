@@ -25,9 +25,24 @@ class Metrics:
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = defaultdict(float)
         self._gauges: Dict[str, float] = {}
+        self._labels: Dict[str, str] = {}
         self._timings: Dict[str, Deque[float]] = defaultdict(
             lambda: deque(maxlen=window)
         )
+
+    def label(self, name: str, value: str) -> None:
+        """Attach a string dimension to this sink (e.g.
+        ``comm_backend="host"|"xla"``). Labels ride ``snapshot`` under
+        their bare name so every numeric series in an evidence JSON is
+        distinguishable by the dimensions that produced it. Consumers
+        that aggregate snapshot values filter by key suffix
+        (``_avg_ms``...) and are never handed a label by those filters."""
+        with self._lock:
+            self._labels[name] = str(value)
+
+    def labels(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._labels)
 
     def incr(self, name: str, value: float = 1.0) -> None:
         with self._lock:
@@ -60,8 +75,9 @@ class Metrics:
         with self._lock:
             self._timings.clear()
 
-    def snapshot(self) -> Dict[str, float]:
-        """Flat dict: counters as-is; timings as name_{avg,p50,p95,max}_ms.
+    def snapshot(self) -> "Dict[str, float | str]":
+        """Flat dict: counters/gauges as-is, labels as strings, timings
+        as name_{avg,p50,p95,max}_ms.
 
         High-cardinality producers (the transport's per-lane ``comm_l*``
         timers) share this one sink; consumers filter the returned dict
@@ -73,10 +89,11 @@ class Metrics:
         outlier (VERDICT r4 weak #6)."""
         import math
 
-        out: Dict[str, float] = {}
+        out: "Dict[str, float | str]" = {}
         with self._lock:
             out.update(self._counters)
             out.update(self._gauges)
+            out.update(self._labels)  # string dimensions (see label())
             for name, window in self._timings.items():
                 if window:
                     vals = sorted(window)

@@ -31,8 +31,14 @@ class WireStubManager:
         self._ctx = ctx
         self._world = world
         self.metrics = Metrics()
+        self.metrics.label(
+            "comm_backend", str(getattr(ctx, "backend_name", "none"))
+        )
         self._use_async_quorum = True
         self._error = None
+
+    def comm_backend(self) -> str:
+        return str(getattr(self._ctx, "backend_name", "none"))
 
     def start_quorum(self, **kw) -> None:
         self._error = None
@@ -61,6 +67,12 @@ class WireStubManager:
 
     def num_participants(self) -> int:
         return self._world
+
+    def transport_world_size(self) -> int:
+        return self._world
+
+    def is_solo_wire(self) -> bool:
+        return self._error is None and self._world == 1
 
     def wire_is_lossy(self) -> bool:
         return self._ctx.wire_is_lossy()
