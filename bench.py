@@ -1655,6 +1655,12 @@ def _run() -> None:
     _PARTIAL["comm_backend"] = _m.get(
         "comm_backend", manager.comm_backend()
     )
+    # Flight-recorder sanity: how many lifecycle events the manager's
+    # ring recorded over the run (0 would mean the recorder was disabled
+    # or an emit path regressed — the smoke gate checks this).
+    _PARTIAL["t1_events_recorded"] = int(
+        getattr(getattr(manager, "events", None), "next_seq", 0) or 0
+    )
     # Step-pipeline stage breakdown (per-bucket d2h/ef/wire/h2d wall
     # times recorded by the DDP wrapper into the manager's sink) and the
     # overlap gauge: t1_pipeline_overlap = 1 - exposed/total, where
@@ -1917,6 +1923,7 @@ def _run() -> None:
             "t1_lane_balance": t1_lane_balance,
             "t1_fused_steps": t1_fused,
             "t1_classic_steps": t1_classic,
+            "t1_events_recorded": _PARTIAL.get("t1_events_recorded"),
             "t1_phase_ms": t1_phase_ms,
             "t1_min_replica_world": t1_min_world,
             "t1_participants_min": min(t1_parts),

@@ -132,6 +132,9 @@ Response Lighthouse::handle(const Request& req) {
   if (req.path == "/status" && req.method == "GET") {
     return handle_status();
   }
+  if (req.path == "/status.json" && req.method == "GET") {
+    return handle_status_json();
+  }
   if (req.path == "/statsz" && req.method == "GET") {
     // Transport-level stats (JSON): with client connection pooling the
     // accepted count stays near the number of distinct clients instead of
@@ -295,6 +298,42 @@ Response Lighthouse::handle_status() {
     html << "</table>";
   }
   return Response{200, "text/html", html.str()};
+}
+
+Response Lighthouse::handle_status_json() {
+  // Machine-readable twin of /status: the fleet discovery root. Each
+  // quorum participant entry carries the manager control address AND
+  // the replica group's store address — a poller resolves per-rank
+  // checkpoint/telemetry servers from the store's checkpoint_addr_{r}
+  // keys (the same keys the heal plane's multi-host fan-out uses).
+  ftjson::Object o;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    int64_t now = fthttp::now_ms();
+    auto decision = ftquorum::quorum_compute(now, state_, opts_.quorum);
+    o["reason"] = decision.reason;
+    o["now_ms"] = now;
+    if (state_.prev_quorum.has_value()) {
+      const auto& q = *state_.prev_quorum;
+      o["quorum"] = q.to_json();
+      o["quorum_age_ms"] = wall_ms() - q.created_ms;
+      int64_t max_step = 0;
+      for (const auto& p : q.participants)
+        max_step = std::max(max_step, p.step);
+      o["max_step"] = max_step;
+    }
+    ftjson::Object hb;
+    for (const auto& h : state_.heartbeats) {
+      ftjson::Object entry;
+      entry["age_ms"] = now - h.second;
+      entry["dead"] =
+          now - h.second >=
+          static_cast<int64_t>(opts_.quorum.heartbeat_timeout_ms);
+      hb[h.first] = ftjson::Value(std::move(entry));
+    }
+    o["heartbeats"] = ftjson::Value(std::move(hb));
+  }
+  return Response{200, "application/json", ftjson::Value(std::move(o)).dump()};
 }
 
 Response Lighthouse::handle_kill(const std::string& replica_id) {

@@ -6,6 +6,9 @@
 //   POST /torchft.LighthouseService/Heartbeat
 //   GET  /            dashboard HTML
 //   GET  /status      dashboard fragment (polled by the dashboard JS)
+//   GET  /status.json machine-readable fleet status (quorum members with
+//                     manager/store addresses + per-replica heartbeat
+//                     ages) — the discovery root for scripts/fleet_top.py
 //   POST /replica/{id}/kill   proxies a Kill RPC to that replica's manager
 //
 // Design: one mutex + condition_variable guard all state; the quorum RPC
@@ -47,6 +50,7 @@ class Lighthouse {
   fthttp::Response handle_quorum(const fthttp::Request& req);
   fthttp::Response handle_heartbeat(const fthttp::Request& req);
   fthttp::Response handle_status();
+  fthttp::Response handle_status_json();
   fthttp::Response handle_kill(const std::string& replica_id);
   // Runs the decision kernel; on success publishes a new quorum and wakes
   // waiters. Caller must hold mu_.

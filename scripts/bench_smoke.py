@@ -3,9 +3,13 @@
 the streamed-pipeline gauges are present and finite; also run one tiny
 in-process heal round (heal_* gauges), one short streaming-DiLoCo
 round (outer_* gauges — outer_wire_ms / outer_overlap — plus the
-t1_outer_overlap payload key), and one xla-backend allreduce round
+t1_outer_overlap payload key), one xla-backend allreduce round
 under a forced host device count (backend-tagged comm_* gauges +
-comm_backend label, comm/xla_backend.py).
+comm_backend label, comm/xla_backend.py), and one flight-recorder
+round (a solo manager's lifecycle events dumped and converted with
+to_chrome_trace — fails on invalid Chrome-trace JSON or missing
+quorum/step_commit events; bench payload must carry a positive
+t1_events_recorded).
 
 Driven by ``BENCH_SMOKE=1 scripts/test.sh``. The point is that a metric
 regression (a renamed key, a gauge that silently stopped being computed,
@@ -263,6 +267,73 @@ def xla_smoke() -> "list[str]":
     return failures
 
 
+def events_smoke() -> "list[str]":
+    """One in-process flight-recorder round: a solo Manager over a live
+    lighthouse runs two committed steps, its event ring is dumped, and
+    ``to_chrome_trace`` must produce valid Chrome-trace JSON containing
+    the quorum and step_commit lifecycle — so a renamed event kind, a
+    dead emit path, or a broken converter fails this gate loudly."""
+    from torchft_tpu.comm.store import StoreServer
+    from torchft_tpu.control import Lighthouse
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.utils.events import (
+        to_chrome_trace,
+        validate_chrome_trace,
+    )
+
+    failures = []
+    lighthouse = Lighthouse(min_replicas=1, join_timeout_ms=100)
+    store = StoreServer()
+    manager = None
+    try:
+        manager = Manager(
+            min_replica_size=1,
+            timeout=20.0, quorum_timeout=20.0, connect_timeout=20.0,
+            rank=0, world_size=1,
+            store_addr=store.addr,
+            lighthouse_addr=lighthouse.address(),
+            replica_id="events_smoke_",
+            heartbeat_interval=0.05,
+        )
+        import numpy as np
+
+        for _ in range(2):
+            manager.start_quorum(allow_heal=False)
+            manager.allreduce_arrays(
+                [np.ones(8, np.float32)]
+            ).future().result(timeout=20)
+            if not manager.should_commit():
+                failures.append("events smoke: solo step did not commit")
+        dump = manager.events.dump()
+        kinds = {e["kind"] for e in dump["events"]}
+        for want in ("quorum_start", "quorum_complete", "step_commit"):
+            if want not in kinds:
+                failures.append(
+                    f"events smoke: no {want!r} event recorded "
+                    f"(have {sorted(kinds)})"
+                )
+        trace = to_chrome_trace([dump])
+        # round-trip through real JSON — the artifact contract
+        trace = json.loads(json.dumps(trace))
+        problems = validate_chrome_trace(trace)
+        failures += [f"events smoke: trace invalid: {p}" for p in problems]
+        names = {e.get("name") for e in trace.get("traceEvents", [])}
+        for want in ("quorum", "step_commit"):
+            if want not in names:
+                failures.append(
+                    f"events smoke: merged trace missing {want!r} "
+                    f"(have {sorted(n for n in names if n)})"
+                )
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"events smoke: round failed: {e!r}")
+    finally:
+        if manager is not None:
+            manager.shutdown(wait=False)
+        store.shutdown()
+        lighthouse.shutdown()
+    return failures
+
+
 def main() -> int:
     env = {
         k: v for k, v in os.environ.items()
@@ -306,11 +377,19 @@ def main() -> int:
     failures = heal_smoke()
     failures += diloco_smoke()
     failures += xla_smoke()
+    failures += events_smoke()
     for key in ("t1_pipeline_overlap", "t1_pipeline_ms", "t1_ddp_streamed",
                 "t1_overhead_ms", "t1_outer_overlap", "t1_outer_wire_ms",
-                "comm_backend"):
+                "comm_backend", "t1_events_recorded"):
         if key not in payload:
             failures.append(f"missing key {key!r}")
+    recorded = payload.get("t1_events_recorded")
+    if recorded is not None and int(recorded or 0) <= 0:
+        failures.append(
+            "bench recorded zero lifecycle events "
+            f"(t1_events_recorded={recorded!r}) — recorder disabled or "
+            "emit paths regressed"
+        )
     classic = payload.get("t1_classic_steps") or 0
     if classic > 0 and not failures:
         # The DDP path ran: the gauges must be real finite numbers.
@@ -343,7 +422,8 @@ def main() -> int:
         f"classic_steps={classic} "
         f"stages={sorted(payload['t1_pipeline_ms'])} "
         f"comm_backend={payload.get('comm_backend')} "
-        "heal_gauges=ok outer_gauges=ok xla_gauges=ok"
+        f"events_recorded={payload.get('t1_events_recorded')} "
+        "heal_gauges=ok outer_gauges=ok xla_gauges=ok chrome_trace=ok"
     )
     return 0
 

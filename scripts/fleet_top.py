@@ -1,0 +1,300 @@
+#!/usr/bin/env python
+"""fleet_top: one-screen fleet telemetry for a torchft_tpu job.
+
+Discovery walks the same path a healing replica does: the lighthouse's
+``/status.json`` names every quorum participant (manager address + the
+replica group's store address); each group's store holds
+``checkpoint_addr_{rank}`` — the per-rank checkpoint HTTP server, which
+since PR 7 also serves ``GET /telemetry/metrics`` and
+``GET /telemetry/events?since=<seq>``. No new ports, no agents.
+
+    python scripts/fleet_top.py --lighthouse http://host:29510
+    python scripts/fleet_top.py --lighthouse ... --once
+    python scripts/fleet_top.py --lighthouse ... --trace out.json --once
+
+Per poll, every reachable rank contributes one row: step, quorum epoch,
+commit/discard counters, allreduce p50, heal throughput, pipeline/outer
+overlap gauges, and the last flight-recorder event. Event polls are
+seq-cursored (incremental); ``--trace`` merges every rank's full event
+dump into one Chrome/Perfetto ``trace_event`` JSON via
+``torchft_tpu.utils.events.to_chrome_trace``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from torchft_tpu.utils.events import to_chrome_trace  # noqa: E402
+
+
+def fetch_json(url: str, timeout: float) -> Dict[str, Any]:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def discover_managers(
+    lighthouse: str, timeout: float
+) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Resolve every (replica, rank) telemetry base URL from the
+    lighthouse. Returns ``(status_json, endpoints)`` where each endpoint
+    is ``{replica_id, rank, step, manager_addr, url}`` (``url`` may be
+    None with ``error`` set when a group's store was unreachable).
+    Store walks fan out per replica group: a DEAD group's store blocks
+    its connect retry for the full ``timeout``, and paying that serially
+    would stall the whole screen during an incident."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from torchft_tpu.comm.store import StoreClient
+
+    status = fetch_json(lighthouse.rstrip("/") + "/status.json", timeout)
+    members = status.get("quorum", {}).get("participants", [])
+
+    def _walk(member: Dict[str, Any]) -> List[Dict[str, Any]]:
+        base = {
+            "replica_id": member.get("replica_id", "?"),
+            "step": member.get("step"),
+            "manager_addr": member.get("address", ""),
+        }
+        world = int(member.get("world_size", 1) or 1)
+        try:
+            store = StoreClient(
+                member.get("store_address", ""), connect_timeout=timeout
+            )
+            out = []
+            for rank in range(world):
+                raw = store.get(f"checkpoint_addr_{rank}")
+                ep = dict(base, rank=rank)
+                if raw:
+                    ep["url"] = raw.decode()
+                else:
+                    ep["url"] = None
+                    ep["error"] = f"no checkpoint_addr_{rank} in store"
+                out.append(ep)
+            return out
+        except Exception as e:  # noqa: BLE001 — a dead group's store is
+            # expected fleet weather; report the row, keep polling peers
+            return [dict(base, rank=0, url=None, error=repr(e)[:120])]
+
+    endpoints: List[Dict[str, Any]] = []
+    if members:
+        with ThreadPoolExecutor(
+            max_workers=min(8, len(members))
+        ) as pool:
+            for eps in pool.map(_walk, members):
+                endpoints.extend(eps)
+    return status, endpoints
+
+
+def poll_manager(url: str, since: int, timeout: float) -> Dict[str, Any]:
+    """One incremental poll of a manager's telemetry plane: metrics
+    snapshot + events past ``since``. Raises on network errors (caller
+    renders the row as unreachable)."""
+    metrics = fetch_json(url.rstrip("/") + "/telemetry/metrics", timeout)
+    events = fetch_json(
+        url.rstrip("/") + f"/telemetry/events?since={int(since)}", timeout
+    )
+    return {"metrics": metrics, "events": events}
+
+
+def _fmt(v: Any, nd: int = 1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def build_row(ep: Dict[str, Any],
+              polled: Optional[Dict[str, Any]],
+              error: Optional[str] = None,
+              last_event: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Flatten one endpoint's poll into the display row (pure — unit
+    tested against canned payloads). ``last_event``: cached most-recent
+    event for this endpoint, shown with a growing age when the
+    INCREMENTAL poll returns nothing new — a wedged replica emitting no
+    events is exactly when the last-event column matters."""
+    row = {
+        "replica": str(ep.get("replica_id", "?"))[:24],
+        "rank": ep.get("rank", 0),
+        "step": ep.get("step"),
+        "epoch": None,
+        "committed": None,
+        "discarded": None,
+        "allreduce_p50_ms": None,
+        "heal_mb_s": None,
+        "ddp_overlap": None,
+        "outer_overlap": None,
+        "last_event": "-",
+        "error": error,
+    }
+    if polled is None:
+        return row
+    tel = polled.get("metrics", {})
+    m = tel.get("metrics", {})
+    row["step"] = tel.get("step", row["step"])
+    row["epoch"] = tel.get("epoch")
+    if tel.get("healing"):
+        row["replica"] += " (healing)"
+    row["committed"] = m.get("steps_committed")
+    row["discarded"] = m.get("steps_discarded")
+    row["allreduce_p50_ms"] = m.get("allreduce_p50_ms")
+    bps = m.get("heal_wire_bytes_per_s") or m.get("heal_bytes_per_s")
+    row["heal_mb_s"] = None if bps is None else bps / 1e6
+    wt, we = m.get("ddp_wire_total_avg_ms"), m.get("ddp_wire_exposed_avg_ms")
+    # `we` can be absent while `wt` is present (the pair is recorded as
+    # two separate observations; a snapshot can land between them)
+    if wt and we is not None:
+        row["ddp_overlap"] = max(0.0, min(1.0, 1.0 - we / wt))
+    row["outer_overlap"] = m.get("outer_overlap")
+    evs = polled.get("events", {}).get("events", [])
+    last = evs[-1] if evs else last_event
+    if last:
+        age = max(0.0, time.time() - float(last.get("t_wall", 0.0)))
+        row["last_event"] = f"{last.get('kind', '?')} ({age:.1f}s ago)"
+    return row
+
+
+_COLUMNS = (
+    ("replica", 34), ("rank", 4), ("step", 6), ("epoch", 5),
+    ("committed", 9), ("discarded", 9), ("allreduce_p50_ms", 16),
+    ("heal_mb_s", 9), ("ddp_overlap", 11), ("outer_overlap", 13),
+    ("last_event", 34),
+)
+
+
+def render(status: Dict[str, Any], rows: List[Dict[str, Any]]) -> str:
+    out = []
+    q = status.get("quorum", {})
+    out.append(
+        f"fleet_top — quorum id {q.get('quorum_id', '-')} · "
+        f"{len(q.get('participants', []))} participants · "
+        f"max step {status.get('max_step', '-')} · "
+        f"age {_fmt((status.get('quorum_age_ms') or 0) / 1000.0)}s"
+    )
+    out.append(f"  {status.get('reason', '')}")
+    hdr = " ".join(name.ljust(w) for name, w in _COLUMNS)
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for row in sorted(rows, key=lambda r: (r["replica"], r["rank"])):
+        if row.get("error"):
+            out.append(
+                f"{row['replica'].ljust(34)} {str(row['rank']).ljust(4)} "
+                f"UNREACHABLE: {row['error']}"
+            )
+            continue
+        cells = []
+        for name, w in _COLUMNS:
+            v = row.get(name)
+            nd = 2 if "overlap" in name else 1
+            cells.append(_fmt(v, nd).ljust(w))
+        out.append(" ".join(cells))
+    dead = [
+        rid for rid, hb in status.get("heartbeats", {}).items()
+        if hb.get("dead")
+    ]
+    if dead:
+        out.append(f"dead heartbeats: {', '.join(sorted(dead))}")
+    return "\n".join(out)
+
+
+def gather_trace(endpoints: List[Dict[str, Any]],
+                 timeout: float) -> Dict[str, Any]:
+    """Full event dumps (since=0) from every reachable rank, merged into
+    one Chrome trace."""
+    dumps = []
+    for ep in endpoints:
+        if not ep.get("url"):
+            continue
+        try:
+            dumps.append(fetch_json(
+                ep["url"].rstrip("/") + "/telemetry/events?since=0",
+                timeout,
+            ))
+        except Exception as e:  # noqa: BLE001
+            print(
+                f"warning: no events from {ep['url']}: {e!r}",
+                file=sys.stderr,
+            )
+    return to_chrome_trace(dumps)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--lighthouse", required=True,
+                    help="lighthouse address, e.g. http://host:29510")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval seconds (looping mode)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one snapshot and exit")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--trace", metavar="OUT.json",
+                    help="also write the merged Chrome trace (open in "
+                         "chrome://tracing or ui.perfetto.dev)")
+    args = ap.parse_args()
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    cursors: Dict[str, int] = {}
+    last_events: Dict[str, Dict[str, Any]] = {}
+
+    def _poll_one(ep: Dict[str, Any]) -> Dict[str, Any]:
+        url = ep.get("url")
+        if not url:
+            return build_row(ep, None, error=ep.get("error"))
+        try:
+            polled = poll_manager(url, cursors.get(url, 0), args.timeout)
+            cursors[url] = polled["events"].get("next", cursors.get(url, 0))
+            evs = polled["events"].get("events") or []
+            if evs:
+                last_events[url] = evs[-1]
+            return build_row(ep, polled, last_event=last_events.get(url))
+        except Exception as e:  # noqa: BLE001
+            return build_row(ep, None, error=repr(e)[:120])
+
+    while True:
+        try:
+            status, endpoints = discover_managers(
+                args.lighthouse, args.timeout
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"lighthouse unreachable: {e!r}", file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        # fan the per-rank polls out: one hung endpoint must cost ONE
+        # timeout, not a serial walk of the whole fleet
+        if endpoints:
+            with ThreadPoolExecutor(
+                max_workers=min(16, len(endpoints))
+            ) as pool:
+                rows = list(pool.map(_poll_one, endpoints))
+        else:
+            rows = []
+        if not args.once:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear screen, home
+        print(render(status, rows))
+        if args.trace:
+            trace = gather_trace(endpoints, args.timeout)
+            with open(args.trace, "w") as f:
+                json.dump(trace, f)
+            print(
+                f"wrote {len(trace['traceEvents'])} trace events "
+                f"to {args.trace}"
+            )
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

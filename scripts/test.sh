@@ -6,12 +6,16 @@
 #   scripts/test.sh              # tier-1 gate (non-slow tests, CPU devices)
 #   FULL=1 scripts/test.sh       # native build + entire suite (slow included)
 #   BENCH_SMOKE=1 scripts/test.sh  # one short bench.py window + one tiny
-#                                  # heal round + one streaming-DiLoCo round;
-#                                  # asserts the streamed-pipeline, heal_* AND
-#                                  # outer_* (t1_outer_overlap/outer_wire_ms)
-#                                  # gauges are present and finite (metric
-#                                  # regressions fail loudly instead of
-#                                  # vanishing from the artifact)
+#                                  # heal round + one streaming-DiLoCo round
+#                                  # + one xla allreduce round + one
+#                                  # flight-recorder round; asserts the
+#                                  # streamed-pipeline, heal_*, outer_* and
+#                                  # backend-tagged comm_* gauges are present
+#                                  # and finite, AND that lifecycle events
+#                                  # were recorded and convert to valid
+#                                  # Chrome-trace JSON with quorum/step_commit
+#                                  # present (metric/event regressions fail
+#                                  # loudly instead of vanishing)
 
 set -u
 cd "$(dirname "$0")/.."

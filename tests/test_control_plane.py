@@ -70,6 +70,45 @@ def test_lighthouse_heartbeat(lighthouse) -> None:
     lighthouse_heartbeat(lighthouse.address(), "hb_rep")
 
 
+def test_lighthouse_status_json(lighthouse) -> None:
+    # Machine-readable fleet status — the discovery root for
+    # scripts/fleet_top.py: quorum members carry manager AND store
+    # addresses, heartbeats carry ages + a dead flag.
+    import json
+
+    addr = lighthouse.address()
+    # before any quorum: reason present, no quorum key
+    empty = json.load(
+        urllib.request.urlopen(addr + "/status.json", timeout=5)
+    )
+    assert "reason" in empty and "quorum" not in empty
+    lighthouse_quorum(
+        addr,
+        {
+            "replica_id": "statusj",
+            "address": "http://mgr:1",
+            "store_address": "store:2",
+            "step": 4,
+            "world_size": 2,
+            "shrink_only": False,
+        },
+        timeout=5.0,
+    )
+    lighthouse_heartbeat(addr, "statusj")
+    status = json.load(
+        urllib.request.urlopen(addr + "/status.json", timeout=5)
+    )
+    members = status["quorum"]["participants"]
+    assert [m["replica_id"] for m in members] == ["statusj"]
+    assert members[0]["address"] == "http://mgr:1"
+    assert members[0]["store_address"] == "store:2"
+    assert members[0]["world_size"] == 2
+    assert status["max_step"] == 4
+    assert status["quorum_age_ms"] >= 0
+    hb = status["heartbeats"]["statusj"]
+    assert hb["age_ms"] >= 0 and hb["dead"] is False
+
+
 def test_lighthouse_dashboard(lighthouse) -> None:
     addr = lighthouse.address()
     html = urllib.request.urlopen(addr + "/", timeout=5).read().decode()

@@ -36,6 +36,14 @@ transport's PR 1-2 rebuild; byte primitives shared via comm/wire.py):
   wire only (same astype roundtrip as the gradient transport's bf16
   codec) for bandwidth-starved links.
 
+Telemetry plane: the same HTTP server doubles as the per-manager
+observability endpoint — ``GET /telemetry/metrics`` (the Manager's
+Metrics snapshot, framed with replica/rank/step/epoch) and
+``GET /telemetry/events?since=<seq>`` (the flight recorder's
+seq-cursored lifecycle ring, utils/events.py). Telemetry is NOT gated
+on the checkpoint serving gate; scripts/fleet_top.py polls it fleet-wide
+(docs/operations.md §8).
+
 Trust model: the legacy full-stream endpoint still deserializes PICKLE
 from whatever address quorum metadata names — run on a trusted cluster
 network only. The DEFAULT healer paths (chunked, sharded) use pickle
@@ -547,11 +555,82 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(view[off: off + _SEND_CHUNK])
         self._body_streaming = False
 
+    def _send_json(self, obj: dict) -> None:
+        import json
+
+        body = json.dumps(obj).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _do_telemetry(self, parts, url) -> None:
+        """GET /telemetry/metrics and GET /telemetry/events?since=<seq>.
+
+        Telemetry is NOT gated on the checkpoint serving gate: a fleet
+        poller must get an answer from a replica that is mid-step (gate
+        closed) or has never staged a checkpoint at all. Responses are
+        framed with the Manager-provided identity probe (replica_id,
+        rank, step, quorum epoch) so a poller needs no side channel to
+        attribute them."""
+        from urllib.parse import parse_qs
+
+        server: "CheckpointServer" = self.server.ckpt_server  # type: ignore[attr-defined]
+        base: dict = {}
+        info_fn = server._telemetry_info
+        if callable(info_fn):
+            try:
+                base = dict(info_fn())
+            except Exception as e:  # noqa: BLE001 — framing only; the
+                base = {"telemetry_info_error": repr(e)[:200]}  # payload
+                # below still answers
+        if len(parts) == 2 and parts[1] == "metrics":
+            metrics = server._metrics
+            base["t_wall"] = time.time()
+            base["metrics"] = (
+                metrics.snapshot() if metrics is not None else {}
+            )
+            self._send_json(base)
+            return
+        if len(parts) == 2 and parts[1] == "events":
+            q = parse_qs(url.query)
+            try:
+                since = int(q.get("since", ["0"])[0])
+            except ValueError:
+                self.send_error(400, "bad since cursor (want an integer)")
+                return
+            events = server._events
+            if events is not None:
+                evs, nxt, dropped = events.since(since)
+                base.setdefault("replica_id", events.replica_id)
+                base.setdefault("rank", events.rank)
+                base.update(
+                    events=evs, next=nxt, dropped=dropped,
+                    enabled=events.enabled,
+                )
+            else:
+                base.update(events=[], next=0, dropped=0, enabled=False)
+            base["t_wall"] = time.time()
+            self._send_json(base)
+            return
+        self.send_error(
+            404,
+            "unknown telemetry path (have /telemetry/metrics and "
+            "/telemetry/events?since=<seq>)",
+        )
+
     def do_GET(self) -> None:  # noqa: N802
         from urllib.parse import parse_qs, urlparse
 
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
+        if parts and parts[0] == "telemetry":
+            try:
+                self._do_telemetry(parts, url)
+            except (BrokenPipeError, ConnectionResetError):
+                logger.debug("telemetry poller disconnected")
+            return
         if not parts or parts[0] != "checkpoint":
             self.send_error(404, "unknown path")
             return
@@ -784,6 +863,8 @@ class CheckpointServer(CheckpointTransport[T]):
         self._heal_wire_dtype = heal_wire_dtype
         self._stripe_bytes = int(stripe_bytes)
         self._metrics = None
+        self._events = None          # flight recorder (set_events)
+        self._telemetry_info = None  # identity/state probe (set_telemetry)
         self._cond = threading.Condition()
         self._disallowed = True
         self._staged: Optional[_Staged] = None
@@ -815,8 +896,22 @@ class CheckpointServer(CheckpointTransport[T]):
 
     def set_metrics(self, metrics) -> None:
         """Share a Metrics sink (the Manager's) so heal stage/wire/H2D
-        spans and gauges land next to the step-pipeline timers."""
+        spans and gauges land next to the step-pipeline timers. The
+        same sink is what GET /telemetry/metrics serves."""
         self._metrics = metrics
+
+    def set_events(self, events) -> None:
+        """Share a flight recorder (utils/events.EventRecorder — the
+        Manager's) so GET /telemetry/events can serve the process's
+        lifecycle ring. The server only READS it; emitters stay the
+        manager/transport/wrapper layers."""
+        self._events = events
+
+    def set_telemetry(self, info_fn) -> None:
+        """Register a zero-arg callable returning the identity/state
+        dict (replica_id, rank, step, epoch, ...) that frames every
+        /telemetry response (Manager._telemetry_info)."""
+        self._telemetry_info = info_fn
 
     def send_checkpoint(
         self, dst_ranks: List[int], step: int, state_dict: T,

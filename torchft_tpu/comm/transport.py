@@ -1262,6 +1262,7 @@ class TcpCommContext(CommContext):
         # its own Metrics in via set_metrics so bench surfaces both.
         self.metrics = Metrics()
         self.metrics.label("comm_backend", self.backend_name)
+        self._events = None  # flight recorder (set_events)
 
     def set_metrics(self, metrics: Metrics) -> None:
         """Record lane phase timings into ``metrics`` (call before
@@ -1270,6 +1271,13 @@ class TcpCommContext(CommContext):
         trajectories stay distinguishable in evidence JSONs."""
         self.metrics = metrics
         metrics.label("comm_backend", self.backend_name)
+
+    def set_events(self, events) -> None:
+        """Share a flight recorder (the Manager's): the transport emits
+        one ``error_latched`` event at the START of each latch episode —
+        the wire-level timestamp of a fault, which lands in the merged
+        fleet recording ahead of the step_discard it causes."""
+        self._events = events
 
     # ------------------------------------------------------------ lifecycle
 
@@ -1480,8 +1488,18 @@ class TcpCommContext(CommContext):
 
     def _latch_error(self, e: Exception) -> None:
         with self._lock:
-            if self._error is None:
+            first = self._error is None
+            if first:
                 self._error = e
+        if first:
+            # Emit OUTSIDE self._lock (the recorder has its own lock; no
+            # nesting) and only on the latch edge — follow-on op
+            # failures during the same episode add nothing.
+            ev = self._events
+            if ev:
+                ev.emit(
+                    "error_latched", source="host", error=repr(e)[:200]
+                )
 
     # ------------------------------------------------- wire introspection
     # (CommContext API; the DDP error-feedback arena keys off these.)

@@ -160,6 +160,13 @@ class MeshManager:
         self.compile_count = 0
         self.trace_count = 0
         self.hit_count = 0
+        # Optional flight recorder: every executable build emits one
+        # mesh_compile event (a compile mid-training is exactly the kind
+        # of rare stall a postmortem timeline must show). Set by
+        # XlaCommContext.set_events; with several contexts sharing this
+        # pool the most recently wired Manager's ring receives them —
+        # a compile is process-wide work, any one ring is the truth.
+        self.events = None
 
     def devices(self) -> Tuple:
         if self._devices is None:
@@ -232,8 +239,15 @@ class MeshManager:
         with self._lock:
             self._execs[key] = ex
             self.compile_count += 1
+            compile_count = self.compile_count
             del self._building[key]
         pending.set_result(ex)
+        ev = self.events
+        if ev:
+            ev.emit(
+                "mesh_compile", key=repr(key)[:200],
+                compile_count=compile_count,
+            )
         return ex
 
 
@@ -961,6 +975,7 @@ class XlaCommContext(CommContext):
         self._lock = threading.Lock()
         self.metrics = Metrics()
         self.metrics.label("comm_backend", self.backend_name)
+        self._events = None  # flight recorder (set_events)
 
     def set_metrics(self, metrics: Metrics) -> None:
         """Share the Manager's sink (same contract as TcpCommContext);
@@ -969,6 +984,15 @@ class XlaCommContext(CommContext):
         ``comm_backend`` label."""
         self.metrics = metrics
         metrics.label("comm_backend", self.backend_name)
+
+    def set_events(self, events) -> None:
+        """Share a flight recorder (the Manager's): this context emits
+        ``mesh_reconfigure`` at every configure and ``error_latched`` on
+        each latch edge; the mesh manager emits ``mesh_compile`` when an
+        executable is actually built (first sight of a world size /
+        codec / layout combination)."""
+        self._events = events
+        self._mesh_mgr.events = events
 
     def _resolved_algorithm(self, world_size: int) -> str:
         if self._algorithm == "auto":
@@ -985,7 +1009,14 @@ class XlaCommContext(CommContext):
             self._world_size = world_size
             self._error = None
             self._seq = 0
+            generation = self._generation
+        ev = self._events
         if world_size == 1:
+            if ev:
+                ev.emit(
+                    "mesh_reconfigure", world_size=1,
+                    generation=generation, solo=True,
+                )
             return  # solo: every op is an identity, no group needed
         # The store address is the cohort-shared rendezvous namespace,
         # exactly as for the host transport: every member of a transport
@@ -1001,6 +1032,14 @@ class XlaCommContext(CommContext):
         group = _XlaGroup.join(key, rank, world_size, self, self._timeout)
         with self._lock:
             self._group = group
+        if ev:
+            # after the join so a failed rendezvous doesn't record a
+            # mesh the context never actually entered
+            ev.emit(
+                "mesh_reconfigure", world_size=world_size,
+                generation=generation,
+                algorithm=self._resolved_algorithm(world_size),
+            )
 
     def shutdown(self) -> None:
         with self._lock:
@@ -1014,8 +1053,11 @@ class XlaCommContext(CommContext):
 
     def _latch_error(self, e: Exception) -> None:
         with self._lock:
-            if self._error is None:
+            first = self._error is None
+            if first:
                 self._error = e
+        if first:
+            self._emit_latched(e)
 
     def _latch_group_error(self, group: "_XlaGroup", e: Exception) -> None:
         """Latch only while this context still belongs to ``group``: a
@@ -1023,8 +1065,18 @@ class XlaCommContext(CommContext):
         context reconfigured into a new quorum epoch must not poison
         the healthy epoch's first op."""
         with self._lock:
-            if self._group is group and self._error is None:
+            first = self._group is group and self._error is None
+            if first:
                 self._error = e
+        if first:
+            self._emit_latched(e)
+
+    def _emit_latched(self, e: Exception) -> None:
+        # outside self._lock (the recorder has its own lock; no nesting),
+        # on the latch edge only — same contract as the host transport
+        ev = self._events
+        if ev:
+            ev.emit("error_latched", source="xla", error=repr(e)[:200])
 
     # ------------------------------------------------- wire introspection
 

@@ -300,6 +300,17 @@ class DistributedDataParallel:
     def _metrics(self):
         return getattr(self._manager, "metrics", None)
 
+    def _emit_abort(self, exc: BaseException) -> None:
+        """Flight-recorder note that a step's submit loop died mid-flight
+        (buckets already on the wire, arena sealed until they drain) —
+        the rare failure whose postmortem otherwise requires correlating
+        a caller traceback with lane-thread logs."""
+        ev = getattr(self._manager, "events", None)
+        if ev:
+            ev.emit(
+                "round_abort", source="ddp_submit", error=repr(exc)[:200]
+            )
+
     def _wire_healthy(self) -> bool:
         """Gauge gate: the pipeline wire timers are only meaningful when
         ops actually ride the wire. After a latched transport error every
@@ -612,6 +623,7 @@ class DistributedDataParallel:
                 ) from e
 
             arena.inflight = group.seal(_fail)
+            self._emit_abort(e)
             raise
         t_submitted = time.perf_counter()
 
@@ -688,6 +700,7 @@ class DistributedDataParallel:
             arena.inflight = future_chain(
                 future_all([w.future() for w in works]), _fail
             )
+            self._emit_abort(e)
             raise
         t_submitted = time.perf_counter()
 

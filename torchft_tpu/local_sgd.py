@@ -742,6 +742,16 @@ class LocalSGD:
             "sync round aborted; rolling back %d local steps",
             self._sync_every,
         )
+        ev = getattr(mgr, "events", None)
+        if ev:
+            # the outer-plane lifecycle event: a whole sync round (every
+            # fragment, sync_every inner steps) rolled back to backup
+            ev.emit(
+                "round_abort", source="outer_sync",
+                fragments=len(self._fragments),
+                inner_steps=self._sync_every,
+                error=None if error is None else repr(error)[:200],
+            )
         return self.restore()
 
     def _commit_round(self, rnd: _SyncRound) -> Any:

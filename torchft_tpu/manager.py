@@ -48,6 +48,7 @@ from torchft_tpu.comm.context import (
 from torchft_tpu.comm.store import StoreClient
 from torchft_tpu.control import ManagerClient, ManagerServer
 from torchft_tpu.futures import future_chain, future_timeout
+from torchft_tpu.utils.events import EventRecorder
 from torchft_tpu.utils.metrics import Metrics
 
 logger = logging.getLogger(__name__)
@@ -284,6 +285,20 @@ class Manager:
         self._replica_id = replica_id
         self._logger = _ManagerLogger(self, replica_id, self._rank)
 
+        # Flight recorder: one bounded ring of lifecycle events per
+        # process (quorum_start/complete, step_commit/discard,
+        # heal_start/done, member_dead, error_latched, ...), shared with
+        # the transport and the checkpoint server below exactly like the
+        # metrics sink — served at GET /telemetry/events on the
+        # checkpoint HTTP server. Disable via TORCHFT_TPU_EVENTS=0.
+        self.events = EventRecorder(replica_id=replica_id, rank=self._rank)
+        # quorum_id of the last announced quorum — the "epoch" stamped
+        # onto events, and what orders a merged multi-replica recording.
+        self._quorum_epoch: Optional[int] = None
+        # wire membership (transport_replica_ids) of the last quorum —
+        # the diff against the next quorum yields member_dead events.
+        self._wire_members: "tuple" = ()
+
         self._step = 0
         # (quorum_id, wire-membership fingerprint, in_transport) of the
         # last successful comm.configure — the transport reconfigures
@@ -349,6 +364,25 @@ class Manager:
         )
         if callable(ckpt_set_metrics):
             ckpt_set_metrics(self.metrics)
+        # Share the flight recorder the same way: the transport emits
+        # error_latched (and the xla backend mesh_reconfigure /
+        # mesh_compile) into the one ring this process serves.
+        comm_set_events = getattr(comm, "set_events", None)
+        if callable(comm_set_events):
+            comm_set_events(self.events)
+        ckpt_set_events = getattr(
+            self._checkpoint_transport, "set_events", None
+        )
+        if callable(ckpt_set_events):
+            ckpt_set_events(self.events)
+        # ...and hand the checkpoint server a live identity/state probe
+        # so GET /telemetry/metrics can frame the snapshot with
+        # replica/rank/step/epoch without reaching into the Manager.
+        ckpt_set_tel = getattr(
+            self._checkpoint_transport, "set_telemetry", None
+        )
+        if callable(ckpt_set_tel):
+            ckpt_set_tel(self._telemetry_info)
         # wall-clock anchor for the CURRENT heal: set when the quorum
         # assigns us a heal, cleared when the healed state is applied
         self._heal_t0: Optional[float] = None
@@ -475,13 +509,41 @@ class Manager:
             lambda f: jax.tree_util.tree_unflatten(treedef, f.result()),
         )
 
+    # ------------------------------------------------------------- telemetry
+
+    def _telemetry_info(self) -> Dict[str, Any]:
+        """Identity + live state framing every /telemetry response (the
+        checkpoint server calls this per request; everything here is a
+        plain attribute read)."""
+        return {
+            "replica_id": self._replica_id,
+            "rank": self._rank,
+            "step": self._step,
+            "epoch": self._quorum_epoch,
+            "comm_backend": self.comm_backend(),
+            "participating": self._participating_rank is not None,
+            "healing": self._healing,
+            "batches_committed": self._batches_committed,
+        }
+
     # ---------------------------------------------------------- error model
 
     def report_error(self, e: Exception) -> None:
         """Latch an error: the current step will not commit and the comm
         context will be reconfigured on the next quorum (ref manager.py:305-315)."""
         with self._errored_lock:
+            first = self._errored is None
             self._errored = e
+        if first:
+            # one event per latch episode, not per swallowed future —
+            # start_quorum clears the latch, re-arming the edge trigger
+            ev = self.events
+            if ev:
+                ev.emit(
+                    "error_latched", step=self._step,
+                    epoch=self._quorum_epoch, source="manager",
+                    error=repr(e)[:200],
+                )
 
     def errored(self) -> Optional[Exception]:
         with self._errored_lock:
@@ -598,6 +660,11 @@ class Manager:
     def _async_quorum(
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
     ) -> None:
+        ev = self.events
+        if ev:
+            ev.emit(
+                "quorum_start", step=self._step, epoch=self._quorum_epoch
+            )
         with self.metrics.timed("quorum"):
             quorum = self._quorum_rpc(allow_heal, shrink_only, quorum_timeout)
         self._finish_quorum(quorum, allow_heal)
@@ -614,6 +681,7 @@ class Manager:
         )
 
     def _finish_quorum(self, quorum, allow_heal: bool) -> None:
+        self._quorum_epoch = quorum.quorum_id
         # Async quorum: only the up-to-date (max-step) cohort participates —
         # healing replicas contribute zeros this step. Sync quorum (or
         # allow_heal=False): everyone ON THE WIRE participates
@@ -673,6 +741,30 @@ class Manager:
             t_rank, t_world = quorum.replica_rank, quorum.replica_world_size
             fingerprint = "all"
         self._transport_world_size = t_world if in_transport else 1
+        # Flight recorder: a replica that was on the wire last quorum
+        # and is absent now left the fleet (death, kill, or departure) —
+        # the member_dead events plus the epoch stamps are what let a
+        # merged recording show "epoch N → member_dead → epoch N+1"
+        # without scraping any log.
+        ev = self.events
+        members = tuple(quorum.transport_replica_ids or ())
+        if ev:
+            for gone in sorted(set(self._wire_members) - set(members)):
+                ev.emit(
+                    "member_dead", step=self._step,
+                    epoch=quorum.quorum_id, member=gone,
+                )
+        self._wire_members = members
+        if ev:
+            ev.emit(
+                "quorum_complete", step=self._step,
+                epoch=quorum.quorum_id,
+                wire_world=self._transport_world_size,
+                replica_world=quorum.replica_world_size,
+                participants=self._participating_world_size,
+                max_step=quorum.max_step,
+                heal=bool(quorum.heal),
+            )
         transport_key = (quorum.quorum_id, fingerprint, in_transport)
         if transport_key != self._transport_key:
             if in_transport:
@@ -752,6 +844,13 @@ class Manager:
 
                     self._healing = True
                     self._heal_t0 = _time.perf_counter()
+                    if self.events:
+                        self.events.emit(
+                            "heal_start", step=self._step,
+                            epoch=self._quorum_epoch,
+                            src_rank=quorum.recover_src_rank,
+                            max_step=quorum.max_step,
+                        )
                     self._logger.info(
                         f"healing required, fetching checkpoint metadata "
                         f"from {quorum.recover_src_manager_address} "
@@ -807,17 +906,21 @@ class Manager:
         self._load_state_dict(self._pending_state_dict["user"])
         self._pending_state_dict = None
         self._did_heal = True
+        wall_ms = None
         if self._heal_t0 is not None:
             # heal assignment → healed-state ready, end to end: quorum
             # answer, donor fetch (stage/wire/H2D spans are inside), and
             # the user load_state_dict that just ran
             import time as _time
 
-            self.metrics.gauge(
-                "heal_wall_ms",
-                (_time.perf_counter() - self._heal_t0) * 1000.0,
-            )
+            wall_ms = (_time.perf_counter() - self._heal_t0) * 1000.0
+            self.metrics.gauge("heal_wall_ms", wall_ms)
             self._heal_t0 = None
+        if self.events:
+            self.events.emit(
+                "heal_done", step=self._step, epoch=self._quorum_epoch,
+                wall_ms=None if wall_ms is None else round(wall_ms, 3),
+            )
         self._logger.info("loaded state dict")
 
     # ---------------------------------------------------------------- commit
@@ -888,6 +991,13 @@ class Manager:
             self.metrics.incr(
                 "steps_committed" if should_commit else "steps_discarded"
             )
+            ev = self.events
+            if ev:
+                ev.emit(
+                    "step_commit" if should_commit else "step_discard",
+                    step=self._step, epoch=self._quorum_epoch,
+                    participants=self.num_participants(),
+                )
 
             self._checkpoint_transport.disallow_checkpoint()
 

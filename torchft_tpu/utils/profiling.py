@@ -79,13 +79,19 @@ def timed_span(metrics, name: str, span: Optional[str] = None):
 
 @contextmanager
 def throughput_span(metrics, name: str, nbytes: "int | list"):
-    """``timed_span`` + a derived ``{name}_bytes_per_s`` gauge.
+    """``timed_span`` + a derived ``{name}_bytes_per_s`` gauge + a
+    cumulative ``{name}_bytes`` counter.
 
     The heal plane wraps its wire phase in this so the same block feeds
     the profiler timeline, the ``{name}`` timing window, AND a
-    bandwidth gauge the bench artifacts report directly. ``nbytes`` may
-    be a mutable single-element list when the byte count is only known
-    at exit (a fetch whose manifest arrives inside the span)."""
+    bandwidth gauge the bench artifacts report directly. The gauge is
+    last-write-wins (the most recent span's rate); the counter
+    integrates bytes across the whole run so an incremental poller
+    (scripts/fleet_top.py) can compute TRUE average bandwidth between
+    two polls as Δ``{name}_bytes``/Δt instead of sampling whichever
+    span happened to finish last. ``nbytes`` may be a mutable
+    single-element list when the byte count is only known at exit (a
+    fetch whose manifest arrives inside the span)."""
     start = time.perf_counter()
     try:
         with host_span(name):
@@ -95,8 +101,10 @@ def throughput_span(metrics, name: str, nbytes: "int | list"):
         if metrics is not None:
             metrics.observe(name, elapsed)
             n = nbytes[0] if isinstance(nbytes, list) else nbytes
-            if n and elapsed > 0:
-                metrics.gauge(f"{name}_bytes_per_s", n / elapsed)
+            if n:
+                metrics.incr(f"{name}_bytes", n)
+                if elapsed > 0:
+                    metrics.gauge(f"{name}_bytes_per_s", n / elapsed)
 
 
 class StepProfiler:
@@ -104,8 +112,13 @@ class StepProfiler:
 
     Call ``step()`` once per loop iteration. The trace starts when the
     step counter reaches ``start`` and stops after ``num_steps`` more;
-    ``close()`` (or program exit via ``__del__``) stops a still-open
-    trace if the loop ends early.
+    ``close()`` stops a still-open trace if the loop ends early.
+
+    Also a context manager: ``with StepProfiler() as prof:`` guarantees
+    the trace is closed when the block exits (success or exception) —
+    trainers should prefer this over relying on ``__del__``, which only
+    runs at GC/interpreter-exit time and can silently drop an open
+    trace's tail. The env-var contract is unchanged.
     """
 
     def __init__(self, log_dir: Optional[str] = None,
@@ -157,6 +170,12 @@ class StepProfiler:
             jax.profiler.stop_trace()
             self._active = False
         self._done = True
+
+    def __enter__(self) -> "StepProfiler":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
 
     def __del__(self):  # pragma: no cover — best-effort
         try:

@@ -21,6 +21,7 @@ import numpy as np
 
 from torchft_tpu.comm.context import ReduceOp, Work
 from torchft_tpu.futures import future_chain
+from torchft_tpu.utils.events import EventRecorder
 from torchft_tpu.utils.metrics import Metrics
 
 __all__ = ["WireStubManager"]
@@ -34,6 +35,13 @@ class WireStubManager:
         self.metrics.label(
             "comm_backend", str(getattr(ctx, "backend_name", "none"))
         )
+        # Real-surface parity: the wrappers probe manager.events via
+        # getattr and emit round_abort/... through it — the stub carries
+        # a live recorder so harnesses exercise that path too.
+        self.events = EventRecorder(replica_id="stub", rank=0)
+        set_events = getattr(ctx, "set_events", None)
+        if callable(set_events):
+            set_events(self.events)
         self._use_async_quorum = True
         self._error = None
 
