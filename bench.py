@@ -55,6 +55,117 @@ _PEAK_FLOPS_BY_KIND = [
 ]
 
 
+def _sharded_update_phase() -> dict:
+    """Sharded-weight-update micro-phase (ISSUE 9 byte accounting):
+    one 2-rank loopback A/B — the SAME shard-aligned buckets ride
+    reduce_scatter + 1/N update + params allgather (sharded arm) vs
+    allreduce + full update (replicated arm) — reporting
+    ``t1_opt_update_ms`` / ``t1_opt_state_bytes`` for both arms plus
+    the per-rep bitwise oracle. In-process threads over a real TCP
+    loopback transport (the bench_smoke/diloco harness shape); guarded:
+    a failure yields an ``error`` field, never a lost artifact.
+    BENCH_SHARDED=0 skips it."""
+    import numpy as np
+    import optax
+
+    import jax
+    import jax.numpy as jnp
+
+    from torchft_tpu.comm.store import StoreServer
+    from torchft_tpu.comm.transport import TcpCommContext
+    from torchft_tpu.optim import ShardedOptimizerWrapper
+    from torchft_tpu.utils.wire_stub import run_stub_ranks
+
+    world = int(os.environ.get("BENCH_SHARDED_WORLD", "2"))
+    steps = int(os.environ.get("BENCH_SHARDED_STEPS", "4"))
+    n_leaves = int(os.environ.get("BENCH_SHARDED_LEAVES", "12"))
+    leaf_elems = int(os.environ.get("BENCH_SHARDED_ELEMS", "4096"))
+    rng = np.random.default_rng(17)
+    params0 = {
+        f"w{i:02d}": rng.standard_normal(leaf_elems + i).astype(np.float32)
+        for i in range(n_leaves)
+    }
+    store = StoreServer()
+    out: dict = {"world": world, "steps": steps}
+    try:
+        def rank_fn(sharded: bool):
+            def _fn(mgr, rank: int) -> dict:
+                opt = ShardedOptimizerWrapper(
+                    mgr, optax.adamw(1e-3), sharded=sharded
+                )
+                params = jax.tree_util.tree_map(jnp.asarray, params0)
+                state = opt.init(params)
+                for s in range(steps):
+                    mgr.start_quorum()
+                    grads = jax.tree_util.tree_map(
+                        lambda x: x * np.float32(0.01 * (rank + 1)),
+                        params,
+                    )
+                    params, state, ok = opt.step(params, state, grads)
+                    if not ok:
+                        raise RuntimeError("sharded step discarded")
+                snap = mgr.metrics.snapshot()
+                return {
+                    "opt_update_ms": snap.get("opt_update_avg_ms"),
+                    "opt_state_bytes": snap.get("opt_state_bytes"),
+                    "opt_update_elems": snap.get("opt_update_elems"),
+                    "sha": hash(tuple(
+                        np.asarray(v).tobytes()
+                        for v in jax.tree_util.tree_leaves(params)
+                    )),
+                }
+
+            return _fn
+
+        def run_arm(prefix: str, sharded: bool) -> dict:
+            results = run_stub_ranks(
+                store.addr, prefix, world, rank_fn(sharded),
+                lambda: TcpCommContext(
+                    timeout=20.0, chunk_bytes=_bench_chunk_bytes()
+                ),
+            )
+            return {
+                "opt_update_ms": max(
+                    r["opt_update_ms"] or 0.0 for r in results
+                ),
+                "opt_state_bytes": max(
+                    r["opt_state_bytes"] or 0.0 for r in results
+                ),
+                "opt_state_bytes_total": sum(
+                    r["opt_state_bytes"] or 0.0 for r in results
+                ),
+                "opt_update_elems": max(
+                    r["opt_update_elems"] or 0.0 for r in results
+                ),
+                "shas": [r["sha"] for r in results],
+            }
+
+        _touch("sharded_phase")
+        sh = run_arm("sharded_arm", True)
+        rp = run_arm("replicated_arm", False)
+        out.update(
+            t1_opt_update_ms=round(sh["opt_update_ms"], 3),
+            t1_opt_state_bytes=sh["opt_state_bytes"],
+            t1_opt_update_elems=sh["opt_update_elems"],
+            replicated_opt_update_ms=round(rp["opt_update_ms"], 3),
+            replicated_opt_state_bytes=rp["opt_state_bytes"],
+            replicated_opt_update_elems=rp["opt_update_elems"],
+            state_bytes_ratio=(
+                round(sh["opt_state_bytes"] / rp["opt_state_bytes"], 4)
+                if rp["opt_state_bytes"] else None
+            ),
+            bitwise=(
+                len(set(sh["shas"])) == 1
+                and sh["shas"][0] == rp["shas"][0]
+            ),
+        )
+    except Exception as e:  # noqa: BLE001 — never lose the artifact
+        out["error"] = repr(e)
+    finally:
+        store.shutdown()
+    return out
+
+
 def _sync_algorithms_phase() -> dict:
     """Measured LocalSGD + DiLoCo segments (BASELINE.json configs 3-4).
 
@@ -1887,6 +1998,15 @@ def _run() -> None:
                 return r[key]
         return None
 
+    # Sharded weight update byte accounting (ISSUE 9): a guarded 2-rank
+    # in-process A/B surfacing t1_opt_update_ms / t1_opt_state_bytes
+    # with the replicated arm beside them.
+    sharded_phase = (
+        _sharded_update_phase()
+        if os.environ.get("BENCH_SHARDED", "1") != "0" else None
+    )
+    _PARTIAL["sharded"] = sharded_phase
+
     flops_step = _flops_per_step(cfg, n_params, seq_len, tokens_per_step)
     if peak_flops is not None:
         mfu = flops_step * steps / t1_elapsed / peak_flops
@@ -1924,6 +2044,13 @@ def _run() -> None:
             "t1_fused_steps": t1_fused,
             "t1_classic_steps": t1_classic,
             "t1_events_recorded": _PARTIAL.get("t1_events_recorded"),
+            "t1_opt_update_ms": (
+                (sharded_phase or {}).get("t1_opt_update_ms")
+            ),
+            "t1_opt_state_bytes": (
+                (sharded_phase or {}).get("t1_opt_state_bytes")
+            ),
+            "sharded": sharded_phase,
             "t1_phase_ms": t1_phase_ms,
             "t1_min_replica_world": t1_min_world,
             "t1_participants_min": min(t1_parts),

@@ -12,6 +12,11 @@ Run one replica group (repeat per group, or use torchft_tpu.launcher):
 Kill any replica group at any time: survivors keep committing; the
 relaunched group heals from a live checkpoint and rejoins — the loop below
 needs zero failure-handling code for that.
+
+SHARDED=1 switches the weight update to the ZeRO-style cross-replica
+sharded path (reduce-scatter → 1/N optimizer update → params allgather:
+optimizer state/FLOPs/heal bytes ÷ wire world; docs/architecture.md
+"Sharded weight update"). The flag must match across replica groups.
 """
 
 from __future__ import annotations
@@ -77,12 +82,25 @@ def main() -> None:
         seed=1,
     )
 
+    # SHARDED=1: the sharded wrapper's opt state rides checkpoints/heals
+    # through its fixed-structure shard serialization (a donor ships only
+    # its 1/N shard; the healer reshards onto the live grid) — the
+    # wrapper is bound below, after the Manager exists.
+    sharded = os.environ.get("SHARDED", "0") == "1"
+
     def load_state_dict(sd):
-        state.update(sd["train"])
+        train = dict(sd["train"])
+        if sharded and isinstance(train.get("opt"), dict) \
+                and "slots" in train["opt"]:
+            train["opt"] = opt.load_opt_state_dict(train["opt"])
+        state.update(train)
         sampler.load_state_dict(sd["sampler"])
 
     def state_dict():
-        return {"train": dict(state), "sampler": sampler.state_dict()}
+        train = dict(state)
+        if sharded:
+            train["opt"] = opt.opt_state_dict(state["opt"])
+        return {"train": train, "sampler": sampler.state_dict()}
 
     # Per-group rendezvous store: rank 0 binds it (the group-master
     # TCPStore role); other local ranks connect via MASTER_ADDR/PORT.
@@ -107,11 +125,21 @@ def main() -> None:
         store_addr=store_addr,
         replica_id=f"train_ddp_{replica_group}_",
     )
-    ddp = DistributedDataParallel(manager)
-    opt = OptimizerWrapper(
-        manager, tx,
-        state_fn=lambda: (state["params"], state["opt"]),
-    )
+    if sharded:
+        from torchft_tpu import ShardedOptimizerWrapper
+
+        ddp = None
+        opt = ShardedOptimizerWrapper(
+            manager, tx,
+            state_fn=lambda: (state["params"], state["opt"]),
+        )
+        state["opt"] = opt.init(state["params"])
+    else:
+        ddp = DistributedDataParallel(manager)
+        opt = OptimizerWrapper(
+            manager, tx,
+            state_fn=lambda: (state["params"], state["opt"]),
+        )
     grad_step = make_grad_step(cfg)
     # One fused grad+update executable for solo-wire steps (no data-plane
     # peer): commit barrier first, then a single donated program — the
@@ -152,7 +180,14 @@ def main() -> None:
         while manager.current_step() < total_steps:
             tokens, targets = next_batch()
             opt.begin_step()
-            if opt.can_fuse():  # waits the quorum; latches on failure
+            if sharded:
+                # the sharded wrapper owns the whole reduce→update→
+                # allgather pipeline: hand it the RAW gradients
+                loss, grads = grad_step(state["params"], tokens, targets)
+                new_params, new_opt, committed = opt.step(
+                    state["params"], state["opt"], grads
+                )
+            elif opt.can_fuse():  # waits the quorum; latches on failure
                 new_params, new_opt, loss, committed = opt.fused_step(
                     fused_step, state["params"], state["opt"],
                     tokens, targets,

@@ -334,6 +334,64 @@ def events_smoke() -> "list[str]":
     return failures
 
 
+def sharded_smoke() -> "list[str]":
+    """One 2-rank sharded step over a real loopback wire; fails on
+    missing/non-finite shard gauges (opt_state_bytes /
+    opt_update_elems / opt_update span) or a non-committing step —
+    the ISSUE 9 byte-accounting surface."""
+    import math
+
+    import numpy as np
+    import optax
+
+    import jax
+    import jax.numpy as jnp
+    from torchft_tpu.comm.store import StoreServer
+    from torchft_tpu.comm.transport import TcpCommContext
+    from torchft_tpu.optim import ShardedOptimizerWrapper
+    from torchft_tpu.utils.wire_stub import run_stub_ranks
+
+    failures: "list[str]" = []
+    world = 2
+    store = StoreServer()
+    rng = np.random.default_rng(0)
+    params0 = {
+        f"w{i}": rng.standard_normal(256 + i).astype(np.float32)
+        for i in range(6)
+    }
+
+    def _fn(mgr, rank: int) -> dict:
+        opt = ShardedOptimizerWrapper(mgr, optax.adam(1e-2), sharded=True)
+        params = jax.tree_util.tree_map(jnp.asarray, params0)
+        state = opt.init(params)
+        mgr.start_quorum()
+        grads = jax.tree_util.tree_map(lambda x: x * 0.1, params)
+        params, state, ok = opt.step(params, state, grads)
+        if not ok:
+            raise RuntimeError("sharded step discarded")
+        return mgr.metrics.snapshot()
+
+    try:
+        snaps = run_stub_ranks(
+            store.addr, "sharded_smoke", world, _fn,
+            lambda: TcpCommContext(timeout=15.0), timeout=90,
+        )
+    except Exception as e:  # noqa: BLE001
+        store.shutdown()
+        return [f"sharded smoke: {e!r}"]
+    store.shutdown()
+    for rank, snap in enumerate(snaps):
+        for key in ("opt_state_bytes", "opt_update_elems",
+                    "opt_update_avg_ms"):
+            v = snap.get(key)
+            if v is None or not math.isfinite(float(v)) or float(v) <= 0:
+                failures.append(
+                    f"sharded smoke: gauge {key!r} missing/non-finite "
+                    f"on rank {rank}: {v!r}"
+                )
+    return failures
+
+
 def main() -> int:
     env = {
         k: v for k, v in os.environ.items()
@@ -378,11 +436,21 @@ def main() -> int:
     failures += diloco_smoke()
     failures += xla_smoke()
     failures += events_smoke()
+    failures += sharded_smoke()
     for key in ("t1_pipeline_overlap", "t1_pipeline_ms", "t1_ddp_streamed",
                 "t1_overhead_ms", "t1_outer_overlap", "t1_outer_wire_ms",
-                "comm_backend", "t1_events_recorded"):
+                "comm_backend", "t1_events_recorded",
+                "t1_opt_update_ms", "t1_opt_state_bytes"):
         if key not in payload:
             failures.append(f"missing key {key!r}")
+    sharded = payload.get("sharded") or {}
+    if sharded.get("error"):
+        failures.append(f"bench sharded phase errored: {sharded['error']}")
+    elif sharded and sharded.get("bitwise") is not True:
+        failures.append(
+            "bench sharded phase: sharded arm not bitwise with the "
+            "replicated arm"
+        )
     recorded = payload.get("t1_events_recorded")
     if recorded is not None and int(recorded or 0) <= 0:
         failures.append(
@@ -423,7 +491,9 @@ def main() -> int:
         f"stages={sorted(payload['t1_pipeline_ms'])} "
         f"comm_backend={payload.get('comm_backend')} "
         f"events_recorded={payload.get('t1_events_recorded')} "
-        "heal_gauges=ok outer_gauges=ok xla_gauges=ok chrome_trace=ok"
+        f"opt_state_ratio={(payload.get('sharded') or {}).get('state_bytes_ratio')} "
+        "heal_gauges=ok outer_gauges=ok xla_gauges=ok chrome_trace=ok "
+        "sharded_gauges=ok"
     )
     return 0
 

@@ -1,0 +1,279 @@
+"""Sharded weight update e2e (ISSUE 9 acceptance): kill→shrink→rejoin
+over a live lighthouse WITH the sharded path enabled.
+
+Three replica groups train through ``ShardedOptimizerWrapper`` (real
+Managers, real TCP comm, real HTTP checkpoints). Replica 0 is killed
+mid-run and restarts. Required lifecycle, reconstructed from the
+``/telemetry/events`` endpoints alone (the fleet_top discovery path):
+
+    quorum at wire_world 3 → member_dead → reshard onto the shrunken
+    grid (new_world 2) → step_commit resuming at wire_world 2 →
+    heal_start/heal_done on the rejoiner → reshard back to new_world 3
+    → step_commit past the kill point
+
+plus ``shard_grid_rebuild`` events marking the plan-cache misses.
+"""
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from torchft_tpu.comm.store import StoreClient, StoreServer
+from torchft_tpu.comm.transport import TcpCommContext
+from torchft_tpu.control import Lighthouse
+from torchft_tpu.manager import Manager
+
+logger = logging.getLogger(__name__)
+
+
+class InjectedFailure(Exception):
+    pass
+
+
+def _fetch(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+class _Harness:
+    def __init__(self, num_replicas: int, total_steps: int) -> None:
+        self.num_replicas = num_replicas
+        self.total_steps = total_steps
+        self.stop = threading.Event()
+        self.progress: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def report(self, replica_id: int, step: int) -> None:
+        with self._lock:
+            self.progress[replica_id] = max(
+                self.progress.get(replica_id, 0), step
+            )
+            if len(self.progress) == self.num_replicas and all(
+                s >= self.total_steps for s in self.progress.values()
+            ):
+                self.stop.set()
+
+
+class _Replica:
+    """One replica group training through the sharded wrapper; restarts
+    after the injected kill (and after the documented
+    allgather-after-commit failure window, whose recovery IS restart +
+    heal)."""
+
+    def __init__(self, replica_id: int, lighthouse_addr: str,
+                 harness: _Harness,
+                 fail_at_step: Optional[int] = None) -> None:
+        self.replica_id = replica_id
+        self.lighthouse_addr = lighthouse_addr
+        self.harness = harness
+        self.fail_at_step = fail_at_step
+        self.failures = 0
+        self.telemetry: List[dict] = []
+
+    def run(self) -> None:
+        while not self.harness.stop.is_set():
+            try:
+                self._main()
+                return
+            except InjectedFailure:
+                logger.warning("replica %s restarting after injected kill",
+                               self.replica_id)
+                continue
+            except RuntimeError as e:
+                # the failure-after-vote window: restart + heal
+                logger.warning("replica %s restarting after %s",
+                               self.replica_id, e)
+                continue
+
+    def _main(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from torchft_tpu.optim import ShardedOptimizerWrapper
+
+        store = StoreServer()
+        rng = np.random.default_rng(5)
+        holder = {
+            "params": {
+                f"w{i}": jnp.asarray(
+                    rng.standard_normal(8 + i).astype(np.float32)
+                )
+                for i in range(6)
+            },
+            "opt": None,
+        }
+        opt_box = {"opt": None}  # wrapper bound after the manager exists
+
+        def state_dict():
+            return {
+                "params": {
+                    k: np.asarray(v)
+                    for k, v in holder["params"].items()
+                },
+                "opt": opt_box["opt"].opt_state_dict(holder["opt"]),
+            }
+
+        def load_state_dict(sd):
+            holder["params"] = {
+                k: jnp.asarray(np.asarray(v))
+                for k, v in sd["params"].items()
+            }
+            holder["opt"] = opt_box["opt"].load_opt_state_dict(sd["opt"])
+
+        manager = Manager(
+            comm=TcpCommContext(timeout=5.0),
+            load_state_dict=load_state_dict,
+            state_dict=state_dict,
+            min_replica_size=1,
+            use_async_quorum=True,
+            timeout=5.0, quorum_timeout=5.0, connect_timeout=5.0,
+            rank=0, world_size=1,
+            store_addr=store.addr,
+            lighthouse_addr=self.lighthouse_addr,
+            replica_id=f"sharded_rep_{self.replica_id}_",
+            heartbeat_interval=0.05,
+        )
+        opt = ShardedOptimizerWrapper(
+            manager, optax.adam(1e-2),
+            state_fn=lambda: (holder["params"], holder["opt"]),
+            sharded=True,
+        )
+        opt_box["opt"] = opt
+        holder["opt"] = opt.init(holder["params"])
+        telemetry_url = (
+            StoreClient(store.addr, connect_timeout=5.0)
+            .get("checkpoint_addr_0").decode()
+        )
+        try:
+            while not self.harness.stop.is_set():
+                if (
+                    self.fail_at_step is not None
+                    and self.failures == 0
+                    and manager.current_step() >= self.fail_at_step
+                ):
+                    self.failures += 1
+                    raise InjectedFailure(
+                        f"injected kill of replica {self.replica_id}"
+                    )
+                try:
+                    manager.start_quorum()
+                except (TimeoutError, RuntimeError) as e:
+                    logger.info("quorum retry: %s", e)
+                    continue
+                grads = jax.tree_util.tree_map(
+                    lambda x: x - 10.0, holder["params"]
+                )
+                params, opt_state, committed = opt.step(
+                    holder["params"], holder["opt"], grads
+                )
+                holder["params"], holder["opt"] = params, opt_state
+                if committed:
+                    self.harness.report(
+                        self.replica_id, manager.current_step()
+                    )
+                else:
+                    time.sleep(0.01)
+        finally:
+            try:
+                events = _fetch(telemetry_url + "/telemetry/events?since=0")
+                self.telemetry.append({"events": events})
+            except Exception as e:  # noqa: BLE001
+                self.telemetry.append({"capture_error": repr(e)})
+            manager.shutdown(wait=False)
+            store.shutdown()
+
+
+def _events_of(dump: dict) -> List[dict]:
+    assert "capture_error" not in dump, dump
+    return sorted(dump["events"]["events"], key=lambda e: e["seq"])
+
+
+def test_sharded_kill_shrink_rejoin_lifecycle() -> None:
+    lighthouse = Lighthouse(
+        min_replicas=1, join_timeout_ms=200, heartbeat_timeout_ms=1000
+    )
+    harness = _Harness(num_replicas=3, total_steps=8)
+    replicas = [
+        _Replica(0, lighthouse.address(), harness, fail_at_step=3),
+        _Replica(1, lighthouse.address(), harness),
+        _Replica(2, lighthouse.address(), harness),
+    ]
+    try:
+        with ThreadPoolExecutor(max_workers=3) as pool:
+            futs = [pool.submit(r.run) for r in replicas]
+            deadline = time.monotonic() + 180.0
+            for f in futs:
+                f.result(timeout=max(1.0, deadline - time.monotonic()))
+    finally:
+        harness.stop.set()
+        lighthouse.shutdown()
+
+    assert replicas[0].failures == 1
+    # the killed replica restarted at least once; every replica finished
+    assert all(
+        harness.progress.get(r.replica_id, 0) >= harness.total_steps
+        for r in replicas
+    ), harness.progress
+
+    # -- reconstruct the lifecycle from a SURVIVOR's endpoint dump ------
+    surv = _events_of(replicas[1].telemetry[-1])
+    kinds = [e["kind"] for e in surv]
+    assert "shard_grid_rebuild" in kinds
+    # full-wire quorum seen
+    full_q = [
+        e for e in surv
+        if e["kind"] == "quorum_complete" and e.get("wire_world") == 3
+    ]
+    assert full_q, "never saw a 3-wire quorum"
+    dead = [e for e in surv if e["kind"] == "member_dead"]
+    assert dead, "the kill left no member_dead event"
+    death_seq = dead[0]["seq"]
+    # reshard onto the shrunken grid AFTER the death...
+    shrink_resh = [
+        e for e in surv
+        if e["kind"] == "reshard" and e.get("new_world") == 2
+        and e["seq"] > death_seq
+    ]
+    assert shrink_resh, "no reshard onto the 2-wire grid after the kill"
+    # ...with commits resuming at wire_world 2
+    w2_commits = [
+        e for e in surv
+        if e["kind"] == "step_commit" and e["seq"] > shrink_resh[0]["seq"]
+    ]
+    assert w2_commits, "no commits after the shrink reshard"
+    # the rejoin reshards back to 3 and commits keep flowing past it
+    grow_resh = [
+        e for e in surv
+        if e["kind"] == "reshard" and e.get("new_world") == 3
+        and e["seq"] > death_seq
+    ]
+    assert grow_resh, "no reshard back onto the 3-wire grid"
+    post_grow_commits = [
+        e for e in surv
+        if e["kind"] == "step_commit" and e["seq"] > grow_resh[0]["seq"]
+    ]
+    assert post_grow_commits, "no commits after the rejoin reshard"
+
+    # -- the rejoiner healed (its second incarnation's recording) -------
+    rejoin = _events_of(replicas[0].telemetry[-1])
+    heal_done = [e for e in rejoin if e["kind"] == "heal_done"]
+    assert heal_done, "the rejoiner never recorded heal_done"
+    heal_starts = [e for e in rejoin if e["kind"] == "heal_start"]
+    assert heal_starts and heal_starts[0]["seq"] < heal_done[0]["seq"]
+    # and resharded onto the live grid after the heal
+    rj_resh = [e for e in rejoin if e["kind"] == "reshard"]
+    assert rj_resh, "the rejoiner never resharded"
+    # commits resumed past the kill point on the rejoiner too
+    rj_commits = [
+        e for e in rejoin
+        if e["kind"] == "step_commit"
+        and e["seq"] > heal_done[0]["seq"]
+    ]
+    assert rj_commits, "the rejoiner never committed after healing"

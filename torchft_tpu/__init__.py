@@ -39,6 +39,7 @@ from torchft_tpu.data import DistributedSampler  # noqa: F401
 from torchft_tpu.ddp import (  # noqa: F401
     DistributedDataParallel,
     PureDistributedDataParallel,
+    ShardedGradReducer,
 )
 from torchft_tpu.futures import (  # noqa: F401
     future_chain,
@@ -48,7 +49,11 @@ from torchft_tpu.futures import (  # noqa: F401
 from torchft_tpu.local_sgd import DiLoCo, LocalSGD  # noqa: F401
 from torchft_tpu.manager import Manager, WorldSizeMode  # noqa: F401
 from torchft_tpu.optim import OptimizerWrapper as Optimizer  # noqa: F401
-from torchft_tpu.optim import OptimizerWrapper  # noqa: F401
+from torchft_tpu.optim import (  # noqa: F401
+    OptimizerWrapper,
+    ShardedOptimizerWrapper,
+    ShardedOptState,
+)
 
 __all__ = [
     "AsyncCheckpointWriter",
@@ -67,6 +72,9 @@ __all__ = [
     "Optimizer",
     "OptimizerWrapper",
     "PureDistributedDataParallel",
+    "ShardedGradReducer",
+    "ShardedOptimizerWrapper",
+    "ShardedOptState",
     "load_checkpoint",
     "ReduceOp",
     "SubprocessCommContext",

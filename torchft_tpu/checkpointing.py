@@ -92,6 +92,7 @@ __all__ = [
     "CheckpointServer",
     "fetch_manifest",
     "fetch_leaf",
+    "fetch_opt_shard",
     "format_slice_spec",
     "recv_checkpoint_sharded",
     "serve_copy_stats",
@@ -1779,6 +1780,152 @@ def recv_checkpoint_sharded(
         if total_bytes[0] and wall > 0:
             metrics.gauge("heal_bytes_per_s", total_bytes[0] / wall)
     return jax.tree_util.tree_unflatten(t_def, leaves)
+
+
+def fetch_opt_shard(
+    donors: "Sequence[str]",
+    step: int,
+    needed: "Sequence[int]",
+    state_slots: int,
+    slots_path_re: str = r".*\['slots'\]\[(\d+)\]\[(\d+)\]$",
+    timeout: float = 60.0,
+    parallel: int = 4,
+    metrics: "Optional[Any]" = None,
+) -> "Dict[int, List[np.ndarray]]":
+    """Shard-spec-aware optimizer-state fetch for a healer joining at a
+    *different* world size (the "Memory-efficient array redistribution"
+    recipe specialized to leaf-granular shards).
+
+    Each donor's checkpoint carries only ITS 1/N shard of the per-leaf
+    optimizer states, in a FIXED tree structure where non-held leaves
+    are zero-length placeholder arrays
+    (``ShardedOptimizerWrapper.opt_state_dict``). A donor's MANIFEST is
+    therefore its shard spec: leaf ``i`` is held exactly when every one
+    of its ``state_slots`` slot entries (manifest paths matching
+    ``slots_path_re`` with groups ``(leaf, slot)``) advertises
+    ``nbytes > 0``. This function computes the intersection of
+    ``needed`` against every donor's spec and fetches exactly the
+    missing pieces — each leaf's slot arrays from ONE donor that holds
+    it (lowest in ``donors`` order) over keep-alive connections,
+    generalizing PR 4's dim-0 stripes to shard-spec-to-shard-spec
+    transfer on the same ``/checkpoint/{step}/leaf/{i}`` raw plane.
+
+    Donor-death failover: a donor that dies mid-fetch (network error,
+    not an HTTP protocol error) is marked dead and each of its assigned
+    leaves is refetched from the surviving donors that cover it; the
+    fetch completes whole or raises — no partial shard is returned.
+
+    Returns ``{leaf_index: [slot arrays...]}`` for every index in
+    ``needed`` (feed ``ShardedOptimizerWrapper._unflatten_state`` /
+    ``load_opt_state_dict``-shaped adoption)."""
+    import re as _re
+
+    needed = sorted(set(int(i) for i in needed))
+    if not needed:
+        return {}
+    pat = _re.compile(slots_path_re)
+
+    # donor -> {leaf: {slot: manifest_index}}, only for fully-held leaves
+    coverage: "Dict[str, Dict[int, Dict[int, int]]]" = {}
+    for donor in donors:
+        try:
+            manifest = fetch_manifest(donor, step, timeout=timeout)
+        except Exception as e:  # noqa: BLE001 — a dead donor only
+            # narrows coverage; the assignment below raises if it stays
+            # short
+            logger.warning("opt-shard manifest fetch failed %s: %s",
+                           donor, e)
+            continue
+        slots: "Dict[int, Dict[int, int]]" = {}
+        for mi, entry in enumerate(manifest["leaves"]):
+            m = pat.match(entry.get("path", ""))
+            if m is None or entry.get("kind") != "ndarray":
+                continue
+            if int(entry.get("nbytes", 0)) <= 0:
+                continue
+            leaf, slot = int(m.group(1)), int(m.group(2))
+            slots.setdefault(leaf, {})[slot] = mi
+        coverage[donor] = {
+            leaf: by_slot for leaf, by_slot in slots.items()
+            if len(by_slot) == state_slots
+        }
+
+    def _holders(leaf: int, dead: "set") -> "List[str]":
+        return [
+            d for d in donors
+            if d not in dead and leaf in coverage.get(d, {})
+        ]
+
+    dead: "set" = set()
+    missing = [i for i in needed if not _holders(i, dead)]
+    if missing:
+        raise ConnectionError(
+            f"no donor covers optimizer-state leaves {missing[:8]}"
+            f"{'...' if len(missing) > 8 else ''} at step {step} — "
+            "shard specs do not union to the needed shard (donors died "
+            "or checkpoints predate the sharded wrapper)"
+        )
+
+    conn_pool = _ConnPool(timeout)
+    out: "Dict[int, List[np.ndarray]]" = {}
+    out_lock = threading.Lock()
+    total_bytes = [0]
+
+    def _fetch_leaf_states(leaf: int) -> None:
+        last_exc: "Optional[Exception]" = None
+        for donor in _holders(leaf, dead):
+            by_slot = coverage[donor][leaf]
+            nb = [0]
+            try:
+                with throughput_span(metrics, "heal_wire", nb):
+                    conn = conn_pool.acquire(donor)
+                    try:
+                        arrays = []
+                        for slot in range(state_slots):
+                            arr = fetch_leaf(
+                                donor, step, by_slot[slot],
+                                timeout=timeout, conn=conn,
+                            )
+                            arrays.append(np.asarray(arr))
+                    except BaseException:
+                        conn.close()  # possibly mid-body: not reusable
+                        raise
+                    conn_pool.release(donor, conn)
+                    nb[0] = sum(int(a.nbytes) for a in arrays)
+                with out_lock:
+                    out[leaf] = arrays
+                    total_bytes[0] += nb[0]
+                return
+            except urllib.error.HTTPError:
+                raise  # donor answered: protocol error, not a death
+            except (urllib.error.URLError, http.client.HTTPException,
+                    ConnectionError, socket.timeout, TimeoutError,
+                    OSError) as e:
+                logger.warning(
+                    "opt-shard donor %s died fetching leaf %d: %s",
+                    donor, leaf, e,
+                )
+                dead.add(donor)
+                last_exc = e
+        raise ConnectionError(
+            f"optimizer-state leaf {leaf}: every covering donor died "
+            "mid-fetch"
+        ) from last_exc
+
+    try:
+        with ThreadPoolExecutor(
+            max_workers=max(1, min(parallel, len(needed))),
+            thread_name_prefix="torchft_tpu_opt_shard",
+        ) as pool:
+            futures = [pool.submit(_fetch_leaf_states, i) for i in needed]
+            for f in futures:
+                f.result()
+    finally:
+        conn_pool.close_all()
+    if metrics is not None:
+        metrics.gauge("heal_opt_bytes", float(total_bytes[0]))
+        metrics.incr("heal_opt_bytes_total", float(total_bytes[0]))
+    return out
 
 
 def _recv_chunked(

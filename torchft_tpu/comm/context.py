@@ -146,6 +146,25 @@ class CommContext(ABC):
         writable inputs). Don't read a donated array until the future
         resolves; on error its contents are unspecified."""
 
+    def reduce_scatter(
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,
+        owners: "Optional[Sequence[int]]" = None,
+    ) -> Work:
+        """Reduce ``arrays`` across ranks, delivering each array's reduced
+        values only to its owner rank (``owners[i]``, default
+        ``i % world_size``). The future resolves to the donated array
+        list with THIS rank's owned arrays reduced — bitwise identical to
+        what :meth:`allreduce` would have produced there — and every
+        other array's contents unspecified (donation contract). The
+        collective under the sharded 1/N weight update. Default: not
+        implemented (identity/legacy contexts); the real data planes
+        (host sockets, xla) override."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement reduce_scatter; "
+            "use the host (TcpCommContext) or xla (XlaCommContext) data "
+            "plane for the sharded weight update"
+        )
+
     @abstractmethod
     def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
         """Future resolves to a list of per-rank lists of arrays."""
@@ -231,6 +250,12 @@ class DummyCommContext(CommContext):
     ) -> Work:
         return CompletedWork(list(arrays))
 
+    def reduce_scatter(
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,
+        owners: "Optional[Sequence[int]]" = None,
+    ) -> Work:
+        return CompletedWork(list(arrays))
+
     def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
         return CompletedWork([list(arrays)])
 
@@ -290,6 +315,16 @@ class ErrorSwallowingCommContext(CommContext):
         if self.errored() is not None:
             return CompletedWork(list(arrays))
         return self._wrap(self._inner.allreduce(arrays, op), list(arrays))
+
+    def reduce_scatter(
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,
+        owners: "Optional[Sequence[int]]" = None,
+    ) -> Work:
+        if self.errored() is not None:
+            return CompletedWork(list(arrays))
+        return self._wrap(
+            self._inner.reduce_scatter(arrays, op, owners), list(arrays)
+        )
 
     def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
         if self.errored() is not None:
@@ -353,10 +388,20 @@ class ManagedCommContext(CommContext):
     ) -> Work:
         return self._manager.allreduce_arrays(arrays, op=op)
 
-    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
-        raise NotImplementedError(
-            "managed allgather is not part of the manager surface"
+    def reduce_scatter(
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,
+        owners: "Optional[Sequence[int]]" = None,
+    ) -> Work:
+        return self._manager.reduce_scatter_arrays(
+            arrays, op=op, owners=owners
         )
+
+    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
+        # Manager-mediated allgather with the same error-latch /
+        # report_error semantics as allreduce — the sharded weight
+        # update's param/opt-state exchange needs it (the old hard raise
+        # predates any state-carrying collective on the step path).
+        return self._manager.allgather_arrays(arrays)
 
     def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
         raise NotImplementedError(

@@ -66,6 +66,11 @@ def _child_main(tx: "mp.Queue", rx: "mp.Queue", timeout: float,
                 try:
                     if opcode == "allreduce":
                         work = ctx.allreduce(arrays, op)
+                    elif opcode == "reduce_scatter":
+                        # ``root`` carries the owners list for this
+                        # opcode (unused otherwise) — keeps the command
+                        # tuple layout stable across opcodes.
+                        work = ctx.reduce_scatter(arrays, op, owners=root)
                     elif opcode == "allgather":
                         work = ctx.allgather(arrays)
                     elif opcode == "broadcast":
@@ -254,6 +259,16 @@ class SubprocessCommContext(CommContext):
 
     def allreduce(self, arrays, op: str = ReduceOp.SUM) -> Work:
         return self._submit("allreduce", arrays, op, 0)
+
+    def reduce_scatter(self, arrays, op: str = ReduceOp.SUM,
+                       owners=None) -> Work:
+        """Forwarded to the child's TcpCommContext. NOTE the donation
+        contract weakens across the process boundary: results come back
+        BY VALUE (fresh arrays), with this rank's owned entries reduced
+        and the others unspecified."""
+        if owners is not None:
+            owners = [int(o) for o in owners]
+        return self._submit("reduce_scatter", arrays, op, owners)
 
     def allgather(self, arrays) -> Work:
         return self._submit("allgather", arrays, ReduceOp.SUM, 0)

@@ -449,6 +449,45 @@ def _x64_trace():
     return enable_x64(True)
 
 
+def _build_psum_scatter(mesh_mgr: MeshManager, world_size: int, op: str,
+                        sizes: Sequence[int]):
+    """Compile ONE reduce_scatter executable over ``lax.psum_scatter``:
+    input is a (world, world*L) stacked f32 array (each rank's
+    contributions to every shard, padded to the common slot length L);
+    output is (world, L) where row r is rank r's reduced shard. The
+    hardware-native sharded-update collective — each link moves ~1/n of
+    the payload and no rank ever materializes the full reduction."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = world_size
+    mesh = mesh_mgr.mesh_for(n)
+    axis = mesh_mgr.axis_name
+    L = max(sizes) if sizes else 1
+
+    def fn(stacked):
+        def local(row):
+            x = row[0].reshape(n, L)
+            red = jax.lax.psum_scatter(
+                x, axis, scatter_dimension=0, tiled=False
+            )
+            if op == ReduceOp.AVG:
+                red = red / jnp.float32(n)
+            return jnp.expand_dims(red, 0)
+
+        mesh_mgr._note_trace()
+        return shard_map(
+            local, mesh=mesh, in_specs=(P(axis),), out_specs=P(axis),
+            check_rep=False,
+        )(stacked)
+
+    row = NamedSharding(mesh, P(axis))
+    aval = jax.ShapeDtypeStruct((n, n * L), np.float32, sharding=row)
+    return jax.jit(fn).lower(aval).compile(), row
+
+
 # ------------------------------------------------------ host-side fallback
 
 
@@ -521,15 +560,18 @@ def _host_allreduce(contribs: List[List[np.ndarray]], algorithm: str,
 
 
 class _Sub:
-    __slots__ = ("opcode", "arrays", "op", "root", "fut", "t_submit")
+    __slots__ = ("opcode", "arrays", "op", "root", "fut", "owners",
+                 "t_submit")
 
     def __init__(self, opcode: str, arrays: List[np.ndarray], op: str,
-                 root: int, fut: Future) -> None:
+                 root: int, fut: Future,
+                 owners: "Optional[List[int]]" = None) -> None:
         self.opcode = opcode
         self.arrays = arrays
         self.op = op
         self.root = root
         self.fut = fut
+        self.owners = owners  # reduce_scatter: destination rank per array
         self.t_submit = time.perf_counter()
 
 
@@ -785,7 +827,7 @@ class _XlaGroup:
         ordered = [subs[r] for r in range(n)]
         first = ordered[0]
         sig = [
-            (sub.opcode, sub.op, sub.root,
+            (sub.opcode, sub.op, sub.root, tuple(sub.owners or ()),
              [(a.shape, _dtype_key(a.dtype)) for a in sub.arrays])
             for sub in ordered
         ]
@@ -798,7 +840,7 @@ class _XlaGroup:
         if any(s != sig[0] for s in sig):
             raise ConnectionError(
                 f"xla comm collective mismatch at seq={seq}: ranks "
-                "submitted divergent ops/layouts"
+                "submitted divergent ops/layouts/owners"
             )
         # Per-rank spans land in each member's OWN sink (each Manager
         # shares its Metrics in via set_metrics), same as the host
@@ -809,7 +851,7 @@ class _XlaGroup:
         sinks = [self._members[r].metrics for r in range(n)]
         t_exec = time.perf_counter()
 
-        if first.opcode == "allreduce":
+        if first.opcode in ("allreduce", "reduce_scatter"):
             for sub, m in zip(ordered, sinks):
                 m.observe("comm_submit_wire", t_exec - sub.t_submit)
             self._execute_allreduce(ordered)
@@ -856,6 +898,37 @@ class _XlaGroup:
                 "ReduceOp.AVG requires float arrays (matching the host "
                 "transport, whose in-place integer divide raises)"
             )
+        # REDUCE_SCATTER: same math, narrowed delivery. ``owners[j]`` is
+        # the only rank whose copy of array j is written back (the
+        # others stay unspecified — donation contract). Parity
+        # algorithms (star/ring) REUSE the allreduce executable — same
+        # cache key, zero extra compiles, trivially bitwise with the
+        # replicated arm; the hardware-native path below
+        # (_execute_psum_scatter) lowers to jax.lax.psum_scatter.
+        owners = (
+            ordered[0].owners
+            if ordered[0].opcode == "reduce_scatter" else None
+        )
+        if owners is not None:
+            if len(owners) != len(arrays0) or any(
+                not 0 <= o < n for o in owners
+            ):
+                raise ValueError(
+                    f"reduce_scatter owners {owners} must name a rank in "
+                    f"[0, {n}) per array ({len(arrays0)} submitted)"
+                )
+            if (
+                algorithm == "psum"
+                and op in (ReduceOp.SUM, ReduceOp.AVG)
+                and list(owners) == list(range(n))
+                and all(
+                    _dtype_key(a.dtype) == "<f4"
+                    and _is_device_dtype(a.dtype)
+                    for a in arrays0
+                )
+            ):
+                self._execute_psum_scatter(ordered, op)
+                return
 
         dev_idx = [
             j for j, a in enumerate(arrays0) if _is_device_dtype(a.dtype)
@@ -905,15 +978,55 @@ class _XlaGroup:
 
         # Donation contract: copy the reduced values back into every
         # rank's submitted arrays — callers (the DDP staging arena) rely
-        # on the result aliasing what they submitted. The caller
+        # on the result aliasing what they submitted. REDUCE_SCATTER
+        # narrows the write-back to each array's owner rank. The caller
         # (_execute) resolves the futures after observing the op spans.
         for r, sub in enumerate(ordered):
             for k, j in enumerate(dev_idx):
+                if owners is not None and owners[j] != r:
+                    continue
                 a = sub.arrays[j]
                 np.copyto(a.reshape(-1), outs[k][0].astype(a.dtype,
                                                            copy=False))
             for k, j in enumerate(host_idx):
+                if owners is not None and owners[j] != r:
+                    continue
                 np.copyto(sub.arrays[j], host_results[r][k])
+
+    def _execute_psum_scatter(self, ordered: List[_Sub], op: str) -> None:
+        """Hardware-native reduce_scatter: ``jax.lax.psum_scatter``
+        inside shard_map, one cached executable per (world, sizes)
+        layout like every other collective (the PR 6 pattern). Arrays
+        are padded to one common slot length and stacked (n, n*L); the
+        scatter hands device r the reduced slot r, which lands back in
+        rank r's owned array. SUM/AVG only, f32 only, owners ==
+        range(n) — the sharded-update layout; anything else runs the
+        parity path. Like algorithm='psum' allreduce, the reduction
+        order is XLA's to choose, so this path is outside the bitwise
+        A/B by construction."""
+        import jax
+
+        n = self.world_size
+        arrays0 = ordered[0].arrays
+        sizes = tuple(int(a.size) for a in arrays0)
+        mm = self.mesh_mgr
+        key = (n, "psum_scatter", op, sizes)
+        compiled, row = mm.executable(
+            key, lambda: _build_psum_scatter(mm, n, op, sizes)
+        )
+        L = max(sizes) if sizes else 0
+        if L == 0:
+            return
+        stacked = np.zeros((n, n * L), np.float32)
+        for r, sub in enumerate(ordered):
+            for j, a in enumerate(sub.arrays):
+                stacked[r, j * L: j * L + sizes[j]] = (
+                    np.ascontiguousarray(a).reshape(-1)
+                )
+        out = np.asarray(compiled(jax.device_put(stacked, row)))
+        for r, sub in enumerate(ordered):
+            a = sub.arrays[r]
+            np.copyto(a.reshape(-1), out[r, : sizes[r]])
 
 
 # --------------------------------------------------------------- the context
@@ -1125,7 +1238,8 @@ class XlaCommContext(CommContext):
     # from CommContext — one definition for every data plane.
 
     def _submit(self, opcode: str, arrays: Sequence[np.ndarray], op: str,
-                root: int) -> Work:
+                root: int,
+                owners: "Optional[Sequence[int]]" = None) -> Work:
         fut: Future = Future()
         fut.set_running_or_notify_cancel()
         err = self.errored()
@@ -1151,9 +1265,15 @@ class XlaCommContext(CommContext):
             else:
                 fut.set_result(prepared)
             return Work(fut)
+        if opcode == "reduce_scatter" and owners is None:
+            owners = [i % world for i in range(len(prepared))]
         group.submit(
             self._rank, seq,
-            _Sub(opcode, prepared, op, root, fut), self._timeout,
+            _Sub(
+                opcode, prepared, op, root, fut,
+                owners=None if owners is None else [int(o) for o in owners],
+            ),
+            self._timeout,
         )
         return Work(fut)
 
@@ -1161,6 +1281,18 @@ class XlaCommContext(CommContext):
         self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
     ) -> Work:
         return self._submit("allreduce", arrays, op, 0)
+
+    def reduce_scatter(
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,
+        owners: "Optional[Sequence[int]]" = None,
+    ) -> Work:
+        """Reduce across ranks, delivering each array's result only to
+        its owner (``owners[i]``, default ``i % world_size``) — the host
+        transport's reduce_scatter semantics. Parity algorithms reuse
+        the allreduce executable (bitwise with the replicated arm);
+        ``algorithm='psum'`` with the canonical one-f32-array-per-rank
+        layout lowers to ``jax.lax.psum_scatter``."""
+        return self._submit("reduce_scatter", arrays, op, 0, owners=owners)
 
     def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
         return self._submit("allgather", arrays, ReduceOp.SUM, 0)

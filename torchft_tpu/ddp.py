@@ -93,7 +93,12 @@ import numpy as np
 from torchft_tpu.futures import FutureGroup, future_all, future_chain
 from torchft_tpu.utils.profiling import timed_span
 
-__all__ = ["DistributedDataParallel", "PureDistributedDataParallel"]
+__all__ = [
+    "DistributedDataParallel",
+    "PureDistributedDataParallel",
+    "ShardedGradReducer",
+    "shard_ranges",
+]
 
 _DEFAULT_BUCKET_BYTES = 32 * 1024 * 1024
 
@@ -128,6 +133,32 @@ def _ef_dtype(dt: np.dtype) -> bool:
     _is_compressible) — integer buckets pass through losslessly, so they
     carry no residual."""
     return dt in (np.float32, np.float64)
+
+
+def _ef_gate(manager, error_feedback: "bool | str") -> bool:
+    """THE error-feedback activation rule, shared by the bucketed DDP
+    arena and the sharded reducer (one definition or the bitwise A/B
+    between them could silently diverge): enabled AND this rank's
+    contribution actually crosses the wire through a lossy codec
+    (``wire_compensable`` — role-aware: a star root or ring member's
+    contribution is never encoded) AND this replica contributes real
+    gradients this step (healing/spare replicas ship zeros —
+    compensating those would bank the whole gradient as 'error').
+    ``error_feedback=True`` forces the arena on (documented force
+    semantics); pre-striping managers fall back to codec lossiness."""
+    if error_feedback is False:
+        return False
+    if error_feedback == "auto":
+        compensable = getattr(manager, "wire_compensable", None)
+        if callable(compensable):
+            if not compensable():
+                return False
+        else:
+            lossy = getattr(manager, "wire_is_lossy", None)
+            if not callable(lossy) or not lossy():
+                return False
+    is_part = getattr(manager, "is_participating", None)
+    return (not callable(is_part)) or bool(is_part())
 
 
 class _BucketPlan:
@@ -321,28 +352,8 @@ class DistributedDataParallel:
         return not callable(errored) or errored() is None
 
     def _ef_active(self) -> bool:
-        """Error feedback applies when enabled AND this rank's
-        contribution actually crosses the wire through a lossy codec
-        (``wire_compensable`` — role-aware: a star root or ring member's
-        contribution is never encoded, so its residual would be
-        identically zero and the arena pure overhead) AND this replica is
-        contributing real gradients this step (healing / spare replicas
-        ship zeros — compensating those would bank the whole gradient as
-        'error' and replay it later)."""
-        if self._error_feedback is False:
-            return False
-        if self._error_feedback == "auto":
-            # True skips this gate (documented force semantics: the
-            # arena runs even where the roundtrip is an identity).
-            compensable = getattr(self._manager, "wire_compensable", None)
-            if callable(compensable):
-                if not compensable():
-                    return False
-            else:  # pre-striping manager: fall back to codec lossiness
-                lossy = getattr(self._manager, "wire_is_lossy", None)
-                if not callable(lossy) or not lossy():
-                    return False
-        return self._manager.is_participating()
+        """See :func:`_ef_gate` — the shared activation rule."""
+        return _ef_gate(self._manager, self._error_feedback)
 
     def _get_plan(self, host_leaves: List[np.ndarray]) -> _BucketPlan:
         with self._plan_lock:
@@ -729,6 +740,240 @@ class DistributedDataParallel:
         )
         arena.inflight = fut
         return fut
+
+
+def shard_ranges(sizes: Sequence[int], dtypes: Sequence[np.dtype],
+                 world_size: int) -> "List[Tuple[int, int]]":
+    """THE shard grid of the cross-replica sharded weight update:
+    contiguous, byte-balanced leaf ranges over the flat leaf list, one
+    per wire rank (``comm.wire.split_weighted`` — a pure function of
+    shapes/dtypes, so every rank computes the identical grid). Fewer
+    leaves than ranks leaves the tail ranks owning nothing."""
+    nbytes = [
+        int(sz) * np.dtype(dt).itemsize for sz, dt in zip(sizes, dtypes)
+    ]
+    from torchft_tpu.comm.wire import split_weighted
+
+    return split_weighted(nbytes, max(1, int(world_size)))
+
+
+class _ShardPlan(_BucketPlan):
+    """Shard-aligned bucket plan: leaves split into ``world_size``
+    byte-balanced contiguous ranges (:func:`shard_ranges`), each range's
+    leaves packed into dtype-grouped flat buckets OWNED by that range's
+    rank. Reuses _BucketPlan's staging/pack/unpack byte layout — only
+    the bucket assignment differs, which is what lets the sharded and
+    replicated arms submit byte-identical payloads over identical chunk
+    grids (the bitwise-oracle precondition)."""
+
+    def __init__(self, leaves: Sequence[Any], world_size: int) -> None:
+        self.shapes = [tuple(l.shape) for l in leaves]
+        self.dtypes = [np.dtype(l.dtype) for l in leaves]
+        self.sizes = [int(np.prod(s, dtype=np.int64)) for s in self.shapes]
+        self.world_size = int(world_size)
+        self.ranges = shard_ranges(self.sizes, self.dtypes, world_size)
+        self.buckets: List[List[int]] = []
+        self.owners: List[int] = []
+        for shard, (start, stop) in enumerate(self.ranges):
+            by_dtype: Dict[str, List[int]] = {}
+            for i in range(start, stop):
+                by_dtype.setdefault(self.dtypes[i].str, []).append(i)
+            for _, indices in sorted(by_dtype.items()):
+                self.buckets.append(indices)
+                self.owners.append(shard)
+
+    def owner_of(self, leaf: int) -> int:
+        for shard, (start, stop) in enumerate(self.ranges):
+            if start <= leaf < stop:
+                return shard
+        raise IndexError(f"leaf {leaf} outside the shard grid")
+
+    def owned_leaves(self, rank: int) -> "List[int]":
+        if rank >= len(self.ranges):
+            return []
+        start, stop = self.ranges[rank]
+        return list(range(start, stop))
+
+
+class _ShardArena:
+    """Per-world staging + EF-residual generation for the sharded
+    reducer (one per seen wire world size, cached like the PR 6 mesh).
+    Staging allocates LAZILY at first transport use: a solo wire (or a
+    plan only ever consulted for its grid) must not pin a
+    gradient-sized host arena."""
+
+    __slots__ = ("plan", "staging", "residuals", "ef_generation")
+
+    def __init__(self, plan: _ShardPlan) -> None:
+        self.plan = plan
+        self.staging: "Optional[List[np.ndarray]]" = None
+        self.residuals: "Optional[List[Optional[np.ndarray]]]" = None
+        self.ef_generation: "Optional[int]" = None
+
+
+class ShardedGradReducer:
+    """The gradient stage of the ZeRO-style sharded weight update.
+
+    ``reduce(grads, sharded=True)`` packs the FULL grad pytree into
+    shard-aligned buckets (every rank contributes everything — the
+    upload side is identical to DDP's), reduce-scatters them so each
+    rank RECEIVES only the 1/N byte-balanced leaf-shard its
+    optimizer-state shard consumes, and returns host views of the
+    received leaves. ``sharded=False`` allreduces the SAME buckets over
+    the SAME chunk grid — the replicated A/B arm, whose values on any
+    rank's shard are bitwise identical to the sharded arm's (transport
+    reduce_scatter contract) — and returns every leaf.
+
+    The DDP error-feedback arena rides the upload side unchanged (the
+    full contribution crosses the wire in either mode, so the residual
+    stays full-size; what the sharded mode divides by N is the
+    optimizer state, update FLOPs, and heal bytes — not the EF arena).
+    Residuals reset on every transport incarnation, as in DDP.
+
+    The plan (and its staging arena) is cached PER WIRE WORLD SIZE and
+    rebuilt at the quorum boundary when membership changes the world —
+    the PR 6 mesh-cache pattern — emitting one ``shard_grid_rebuild``
+    flight-recorder event per rebuild."""
+
+    def __init__(self, manager,
+                 error_feedback: "bool | str" = "auto") -> None:
+        if error_feedback not in (True, False, "auto"):
+            raise ValueError(
+                f"error_feedback must be True/False/'auto', "
+                f"got {error_feedback!r}"
+            )
+        self._manager = manager
+        self._error_feedback = error_feedback
+        self._arenas: Dict[int, _ShardArena] = {}
+        self._signature: "Optional[Tuple]" = None
+        self._last_world: "Optional[int]" = None
+        self._lock = threading.Lock()
+
+    def _metrics(self):
+        return getattr(self._manager, "metrics", None)
+
+    def _ef_active(self) -> bool:
+        """See :func:`_ef_gate` — the shared activation rule."""
+        return _ef_gate(self._manager, self._error_feedback)
+
+    def plan_for(self, leaves: Sequence[Any], world: int) -> _ShardPlan:
+        """The cached shard plan for ``world`` (building + arena
+        allocation on first sight). Leaf layout is frozen like the DDP
+        bucket plan — a changed pytree raises."""
+        sig = tuple(
+            (tuple(l.shape), np.dtype(l.dtype).str) for l in leaves
+        )
+        with self._lock:
+            if self._signature is None:
+                self._signature = sig
+            elif sig != self._signature:
+                raise ValueError(
+                    "gradient pytree shape/dtype changed between steps; "
+                    "the sharded-update leaf grid is frozen by design"
+                )
+            arena = self._arenas.get(world)
+            if arena is None:
+                arena = _ShardArena(_ShardPlan(leaves, world))
+                self._arenas[world] = arena
+                ev = getattr(self._manager, "events", None)
+                if ev:
+                    ev.emit(
+                        "shard_grid_rebuild",
+                        old_world=self._last_world, new_world=world,
+                        shards=len(arena.plan.ranges),
+                        buckets=len(arena.plan.buckets),
+                    )
+            self._last_world = world
+            return arena.plan
+
+    def _arena_for(self, world: int) -> _ShardArena:
+        with self._lock:
+            return self._arenas[world]
+
+    def reduce(self, grads: Any,
+               sharded: bool = True) -> "Tuple[_ShardPlan, int, Dict[int, np.ndarray]]":
+        """Blocking reduce of a grad pytree. Returns ``(plan, my_rank,
+        leaves)`` where ``leaves`` maps leaf index → a host view of its
+        reduced, participant-scaled gradient — this rank's shard when
+        ``sharded``, every leaf otherwise. Views alias the step-
+        persistent staging arena: copy (``jnp.array``) before the next
+        reduce. After a latched transport error the contents are
+        unspecified — the step never commits, mirroring DDP."""
+        import jax
+
+        mgr = self._manager
+        try:
+            mgr.wait_quorum()
+        except Exception as e:  # noqa: BLE001 — latch, never raise
+            mgr.report_error(e)
+            leaves = jax.tree_util.tree_flatten(grads)[0]
+            # Throwaway plan for the discarded step: NOT cached (no
+            # staging arena allocated, no shard_grid_rebuild event) — a
+            # transient quorum failure must not pin a gradient-sized
+            # world-1 arena nor pollute the reshard telemetry.
+            return _ShardPlan(leaves, 1), 0, {}
+        world = max(1, int(mgr.transport_world_size()))
+        rank_fn = getattr(mgr, "transport_rank", None)
+        my_rank = int(rank_fn()) if callable(rank_fn) else 0
+
+        leaves = jax.tree_util.tree_flatten(grads)[0]
+        plan = self.plan_for(leaves, world)
+        if world == 1:
+            # Solo wire: the average is an identity; hand back every
+            # leaf without touching the transport (the DDP fast path).
+            return plan, 0, {
+                i: np.asarray(jax.device_get(l))
+                for i, l in enumerate(leaves)
+            }
+        arena = self._arena_for(world)
+        if arena.staging is None:
+            arena.staging = arena.plan.alloc_staging()
+        staging = arena.staging
+        metrics = self._metrics()
+
+        for l in leaves:
+            if hasattr(l, "copy_to_host_async"):
+                l.copy_to_host_async()
+        ef = self._ef_active()
+        if ef:
+            gen_fn = getattr(mgr, "wire_generation", None)
+            gen = int(gen_fn()) if callable(gen_fn) else 0
+            if arena.residuals is None or gen != arena.ef_generation:
+                arena.residuals = [
+                    np.zeros_like(s) if _ef_dtype(s.dtype) else None
+                    for s in staging
+                ]
+                arena.ef_generation = gen
+
+        for k, bucket in enumerate(plan.buckets):
+            with timed_span(metrics, "ddp_d2h", span=f"shard_pack_b{k}"):
+                host_b = [
+                    np.asarray(jax.device_get(leaves[i])) for i in bucket
+                ]
+                packed = plan.pack_bucket_into(bucket, host_b, staging[k])
+            if ef and arena.residuals[k] is not None:
+                res = arena.residuals[k]
+                np.add(packed, res, out=packed)
+                with timed_span(metrics, "ddp_ef"):
+                    mgr.wire_roundtrip(packed, res)  # res = C(g')
+                    np.subtract(packed, res, out=res)
+                    if not np.all(np.isfinite(res)):
+                        np.nan_to_num(res, copy=False,
+                                      nan=0.0, posinf=0.0, neginf=0.0)
+
+        if sharded:
+            work = mgr.reduce_scatter_arrays(staging, owners=plan.owners)
+        else:
+            work = mgr.allreduce_arrays(staging)
+        reduced = work.future().result()
+
+        out: Dict[int, np.ndarray] = {}
+        for k, bucket in enumerate(plan.buckets):
+            if sharded and plan.owners[k] != my_rank:
+                continue
+            for i, view in plan.unpack_bucket(k, reduced[k]):
+                out[i] = view
+        return plan, my_rank, out
 
 
 class PureDistributedDataParallel:

@@ -141,13 +141,20 @@ def _outer_executor(kind: str) -> ThreadPoolExecutor:
         return ex
 
 
+_REMOTE = object()  # staged-slot sentinel: fragment landed on its owner
+
+
 class _SyncRound:
     """One in-flight sync round: the completion group, per-fragment
     staged landings (adopted only on commit), and the wire timestamps
-    the overlap gauges are derived from."""
+    the overlap gauges are derived from. ``world``/``rank`` are the wire
+    membership captured at the round-start fence — the sharded outer
+    plane's fragment→owner map (fragment f is owned by rank
+    ``f % world``) derives from them."""
 
     __slots__ = ("group", "staged", "shipped", "fenced",
-                 "submit_t", "wire_t", "exposed_s", "wire_bytes")
+                 "submit_t", "wire_t", "exposed_s", "wire_bytes",
+                 "world", "rank")
 
     def __init__(self, num_fragments: int) -> None:
         self.group = FutureGroup()
@@ -158,6 +165,8 @@ class _SyncRound:
         self.wire_t = [0.0] * num_fragments
         self.exposed_s = 0.0
         self.wire_bytes = 0
+        self.world = 1
+        self.rank = 0
 
 
 class LocalSGD:
@@ -170,7 +179,8 @@ class LocalSGD:
                  params_fn: Optional[Any] = None,
                  num_fragments: int = 1,
                  streaming: bool = True,
-                 error_feedback: "bool | str" = "auto") -> None:
+                 error_feedback: "bool | str" = "auto",
+                 sharded_outer: bool = False) -> None:
         """``params_fn``: zero-arg callable returning the CURRENT params —
         the same state the Manager's user ``load_state_dict`` writes into.
         Needed for heal: params here are caller-owned values, so after a
@@ -183,7 +193,21 @@ class LocalSGD:
         bitwise oracle). ``error_feedback``: "auto" runs the residual
         arena exactly when this rank's contribution crosses a lossy wire
         codec (``manager.wire_compensable``); True forces it on; False
-        disables it (raw quantization)."""
+        disables it (raw quantization).
+
+        ``sharded_outer``: the fragments BECOME the sharded weight
+        update's shard unit — each fragment's pseudogradient
+        reduce-scatters to its owner rank (``f % wire_world``), ONLY the
+        owner runs that fragment's outer optax step (per-fragment outer
+        state held owner-side only, 1/N outer-state memory and update
+        FLOPs), and the committed round allgathers the updated fragment
+        params back (raw native-dtype bytes, so the committed values
+        stay bitwise identical to the replicated arm). Must match
+        across replicas (it changes the collective sequence); an owner
+        map changed by membership churn — heals included, since a
+        donor ships only its own fragments — reinitializes the moved
+        fragments' outer state at the next round fence, made visible by
+        a ``reshard`` event (see ``_on_owner_map``)."""
         assert sync_every >= 1, "sync_every must be >= 1"
         if num_fragments < 1:
             raise ValueError("num_fragments must be >= 1")
@@ -206,6 +230,8 @@ class LocalSGD:
         self._num_fragments = int(num_fragments)
         self._streaming = bool(streaming)
         self._error_feedback = error_feedback
+        self._sharded_outer = bool(sharded_outer)
+        self._outer_world: "Optional[Tuple[int, int]]" = None
         self._local_step = 0
         self._healed_backup = False
         # Frozen leaf layout (built at register / first step) — the
@@ -527,7 +553,78 @@ class LocalSGD:
                     "healed without params_fn: caller params may be stale "
                     "— pass params_fn to LocalSGD/DiLoCo for correct heal"
                 )
+        rnd = self._round
+        if rnd is not None:
+            world_fn = getattr(mgr, "transport_world_size", None)
+            rank_fn = getattr(mgr, "transport_rank", None)
+            rnd.world = max(
+                1, int(world_fn()) if callable(world_fn) else 1
+            )
+            rnd.rank = int(rank_fn()) if callable(rank_fn) else 0
+            if self._sharded_outer:
+                self._on_owner_map(rnd, params)
         return params
+
+    def _frag_owner(self, rnd: _SyncRound, f: int) -> int:
+        return f % rnd.world
+
+    def _frag_owned(self, rnd: _SyncRound, f: int) -> bool:
+        return (not self._sharded_outer) or rnd.world == 1 or (
+            self._frag_owner(rnd, f) == rnd.rank
+        )
+
+    def _on_owner_map(self, rnd: _SyncRound, params: Any) -> None:
+        """Sharded-outer hook, called once per round after the fence
+        resolved the wire membership: DiLoCo reshards its per-fragment
+        outer states onto the new owner map. Base LocalSGD carries no
+        outer state — nothing to move."""
+
+    def _exchange_fragments(
+        self, rnd: _SyncRound,
+        contrib: "dict[int, List[np.ndarray]]",
+    ) -> "dict[int, List[np.ndarray]]":
+        """Commit-time allgather of updated fragment params: each rank
+        contributes its OWNED fragments' leaves (native dtypes — raw
+        bytes forward verbatim, keeping the committed values bitwise
+        identical to the replicated arm) and receives everyone else's.
+        Returns per-fragment leaf arrays for EVERY fragment. Runs only
+        on a committed round, which is a globally consistent decision —
+        the collective is always matched across the cohort. A failure
+        here means this replica cannot materialize a round the cohort
+        committed: raise so the standard restart+heal path recovers."""
+        F = len(self._fragments)
+        flat: "List[np.ndarray]" = []
+        for f in sorted(contrib):
+            flat.extend(contrib[f])
+        gathered = (
+            self._manager.allgather_arrays(flat).future().result()
+        )
+        errored = getattr(self._manager, "errored", None)
+        if callable(errored) and errored() is not None:
+            raise RuntimeError(
+                "sharded outer round committed but the fragment "
+                f"allgather failed ({errored()}): restart and heal"
+            )
+        out: "dict[int, List[np.ndarray]]" = {}
+        for owner in range(rnd.world):
+            ofrags = [
+                f for f in range(F) if self._frag_owner(rnd, f) == owner
+            ]
+            arrays = gathered[owner] if owner < len(gathered) else []
+            cursor = 0
+            for f in ofrags:
+                start, stop = self._fragments[f]
+                n_leaves = stop - start
+                got = arrays[cursor: cursor + n_leaves]
+                cursor += n_leaves
+                if len(got) != n_leaves:
+                    raise RuntimeError(
+                        f"sharded outer commit: owner {owner} shipped "
+                        f"{len(got)} of {n_leaves} leaves for fragment "
+                        f"{f} — restart and heal"
+                    )
+                out[f] = [np.asarray(a) for a in got]
+        return out
 
     # -- fragment pipeline ---------------------------------------------------
 
@@ -650,15 +747,29 @@ class LocalSGD:
             except Exception:  # noqa: BLE001 — gauge only, never fatal
                 pass
         rnd.submit_t[f] = time.perf_counter()
-        work = mgr.allreduce_arrays([arena])
+        owned = self._frag_owned(rnd, f)
+        if self._sharded_outer and rnd.world > 1:
+            # The fragment IS the shard unit: its averaged value is
+            # delivered only to its owner (same bytes the allreduce
+            # would deliver there — transport reduce_scatter contract);
+            # everyone else skips the landing compute entirely and
+            # receives the owner's UPDATED params at commit.
+            work = mgr.reduce_scatter_arrays(
+                [arena], owners=[self._frag_owner(rnd, f)]
+            )
+        else:
+            work = mgr.allreduce_arrays([arena])
         landed: Future = Future()
         landed.set_running_or_notify_cancel()
         rnd.group.add(landed)
 
-        def _land(wf: Future, f: int = f) -> None:
+        def _land(wf: Future, f: int = f, owned: bool = owned) -> None:
             try:
                 reduced = wf.result()[0]
-                self._land_fragment(rnd, f, reduced)
+                if owned:
+                    self._land_fragment(rnd, f, reduced)
+                else:
+                    rnd.staged[f] = _REMOTE
                 landed.set_result(None)
             except Exception as e:  # noqa: BLE001 — fails the group →
                 landed.set_exception(e)  # the round aborts at commit
@@ -754,13 +865,56 @@ class LocalSGD:
             )
         return self.restore()
 
+    def _frag_native_leaves(self, f: int,
+                            flat: np.ndarray) -> "List[np.ndarray]":
+        """One fragment's averaged f32 arena decoded to native-dtype
+        leaf arrays (ints rounded, not truncated — exact only below
+        2**24; _build_layout warns once). THE f32→native conversion,
+        shared by the local adopt and the sharded exchange so both
+        paths commit identical bytes."""
+        start, stop = self._fragments[f]
+        out: "List[np.ndarray]" = []
+        off = 0
+        for i in range(start, stop):
+            n = self._sizes[i]
+            view = flat[off:off + n].reshape(self._shapes[i])
+            if np.issubdtype(self._dtypes[i], np.integer):
+                # participant-scaled float average of identical ints
+                # can sit an ulp off the integer — round, don't
+                # truncate.
+                leaf = np.rint(view).astype(self._dtypes[i])
+            else:
+                leaf = np.asarray(view).astype(self._dtypes[i])
+            out.append(leaf)
+            off += n
+        return out
+
     def _commit_round(self, rnd: _SyncRound) -> Any:
         """Adopt every fragment's staged average: refresh the backup
-        arena in place and return fresh device params."""
+        arena in place and return fresh device params. Sharded outer:
+        owned fragments adopt locally AND ship through the commit
+        allgather; remote fragments adopt the owner's bytes."""
         import jax
         import jax.numpy as jnp
 
         new_leaves: List[Any] = [None] * len(self._shapes)
+        if self._sharded_outer and rnd.world > 1:
+            contrib = {
+                f: self._frag_native_leaves(f, rnd.staged[f])
+                for f in range(len(self._fragments))
+                if rnd.staged[f] is not _REMOTE
+            }
+            frag_leaves = self._exchange_fragments(rnd, contrib)
+            for f, (start, stop) in enumerate(self._fragments):
+                for j, i in enumerate(range(start, stop)):
+                    np.copyto(self._backup[i], frag_leaves[f][j],
+                              casting="unsafe")
+                    new_leaves[i] = jnp.array(self._backup[i])
+            return jax.tree_util.tree_unflatten(self._treedef, new_leaves)
+        # Replicated arm: decode straight into the persistent backup
+        # arena — zero per-sync allocation, the PR 5 contract (the
+        # allocating _frag_native_leaves path is reserved for sharded
+        # contributions, which need standalone wire buffers).
         for f, (start, stop) in enumerate(self._fragments):
             flat = rnd.staged[f]
             off = 0
@@ -798,11 +952,12 @@ class DiLoCo(LocalSGD):
                  params_fn: Optional[Any] = None,
                  num_fragments: int = 1,
                  streaming: bool = True,
-                 error_feedback: "bool | str" = "auto") -> None:
+                 error_feedback: "bool | str" = "auto",
+                 sharded_outer: bool = False) -> None:
         super().__init__(
             manager, sync_every, params_fn=params_fn,
             num_fragments=num_fragments, streaming=streaming,
-            error_feedback=error_feedback,
+            error_feedback=error_feedback, sharded_outer=sharded_outer,
         )
         self._outer = PartitionedOuterOptimizer(outer_tx)
 
@@ -890,10 +1045,91 @@ class DiLoCo(LocalSGD):
                 f, grads, frag_params
             )
 
-    def _commit_round(self, rnd: _SyncRound) -> Any:
+    def _on_owner_map(self, rnd: _SyncRound, params: Any) -> None:
+        """Sharded outer reshard: fragments are the shard unit, owners
+        are ``f % wire_world``. On an owner-map change (membership
+        churn), drop the states of fragments that left this rank and
+        (re)initialize the ones that arrived — a momentum reset for the
+        moved fragments, surfaced by a ``reshard`` event. NOTE this
+        includes heals: a donor's checkpoint carries only the DONOR's
+        owned fragments, and a healer's wire rank differs from its
+        donor's, so a sharded_outer heal adopts what overlaps (usually
+        nothing) and reinitializes the rest — outer momentum restarts
+        for the healer's shard, visibly. Fragment-state exchange on
+        heal (the ShardedOptimizerWrapper treatment) is future work;
+        jobs that cannot tolerate outer-momentum resets on heal should
+        run the replicated outer plane. Runs once per round, at the
+        fence."""
         import jax
 
+        key = (rnd.world, rnd.rank)
+        states = self._outer.states
+        if states is None or key == self._outer_world:
+            self._outer_world = key
+            return
+        owned = [
+            f for f in range(len(self._fragments))
+            if self._frag_owner(rnd, f) == rnd.rank or rnd.world == 1
+        ]
+        leaves = jax.tree_util.tree_flatten(params)[0]
+        self._check_layout(leaves)
+        moved = dropped = 0
+        new_states: List[Any] = [None] * len(self._fragments)
+        for f in range(len(self._fragments)):
+            if f in owned:
+                if states[f] is not None:
+                    new_states[f] = states[f]
+                else:
+                    start, stop = self._fragments[f]
+                    import jax.numpy as jnp
+
+                    new_states[f] = self._outer.init_fragment(
+                        [jnp.asarray(leaves[i])
+                         for i in range(start, stop)]
+                    )
+                    moved += 1
+            elif states[f] is not None:
+                dropped += 1
+        self._outer.load_states(new_states)
+        old = self._outer_world
+        self._outer_world = key
+        ev = getattr(self._manager, "events", None)
+        if ev:
+            ev.emit(
+                "reshard", source="outer_sync",
+                old_world=None if old is None else old[0],
+                new_world=rnd.world, rank=rnd.rank,
+                owned_fragments=len(owned),
+                reinit_fragments=moved, dropped_fragments=dropped,
+            )
+
+    def _commit_round(self, rnd: _SyncRound) -> Any:
+        import jax
+        import jax.numpy as jnp
+
+        sharded = self._sharded_outer and rnd.world > 1
         new_leaves: List[Any] = [None] * len(self._shapes)
+        if sharded:
+            contrib: "dict[int, List[np.ndarray]]" = {}
+            for f, (start, stop) in enumerate(self._fragments):
+                if rnd.staged[f] is _REMOTE:
+                    continue
+                frag_leaves, new_state = rnd.staged[f]
+                self._outer.adopt(f, new_state)
+                contrib[f] = [
+                    np.asarray(jax.device_get(l)) for l in frag_leaves
+                ]
+            gathered = self._exchange_fragments(rnd, contrib)
+            for f, (start, stop) in enumerate(self._fragments):
+                for j, i in enumerate(range(start, stop)):
+                    np.copyto(
+                        self._backup[i], gathered[f][j], casting="unsafe"
+                    )
+                    # jnp.array (copy): the backup arena is refreshed in
+                    # place next round — an alias would be mutated under
+                    # the caller.
+                    new_leaves[i] = jnp.array(self._backup[i])
+            return jax.tree_util.tree_unflatten(self._treedef, new_leaves)
         for f, (start, stop) in enumerate(self._fragments):
             frag_leaves, new_state = rnd.staged[f]
             self._outer.adopt(f, new_state)

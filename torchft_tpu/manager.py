@@ -492,6 +492,111 @@ class Manager:
             self.report_error(e)
             return CompletedWork(list(arrays))
 
+    def reduce_scatter_arrays(
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,
+        owners: "Optional[Sequence[int]]" = None,
+    ) -> Work:
+        """Fault-tolerant cross-replica reduce_scatter: like
+        :meth:`allreduce_arrays` (zeros while healing, errors latched and
+        never raised, 1/num_participants scaling) except each array's
+        reduced values are delivered only to its owner rank
+        (``owners[i]``, default ``i % transport_world_size``). On this
+        rank the owned arrays come back bitwise identical to what the
+        allreduce path would have produced there — the collective under
+        the sharded 1/N weight update — and every other array's contents
+        are UNSPECIFIED (donation contract). Scaling is applied to owned
+        arrays only."""
+        arrays = [np.asarray(a) for a in arrays]
+        if op == ReduceOp.AVG and any(
+            not _is_float_dtype(a.dtype) for a in arrays
+        ):
+            raise ValueError(
+                "ReduceOp.AVG requires floating-point arrays; got "
+                + str([str(a.dtype) for a in arrays])
+            )
+        if self.errored() is not None:
+            return CompletedWork(list(arrays))
+        try:
+            self.wait_quorum()
+        except Exception as e:  # quorum failed: latch and skip the step
+            self._logger.exception(f"quorum failed in reduce_scatter: {e}")
+            self.report_error(e)
+            return CompletedWork(list(arrays))
+
+        world = max(1, self._transport_world_size)
+        if owners is None:
+            owners = [i % world for i in range(len(arrays))]
+        owners = [int(o) for o in owners]
+        my_rank = self._comm.rank()
+        owned = [i for i, o in enumerate(owners) if o == my_rank]
+
+        if not self.is_participating():
+            arrays = [np.zeros_like(a) for a in arrays]
+
+        try:
+            import time as _time
+
+            submit_time = _time.perf_counter()
+            transport_op = ReduceOp.SUM if op == ReduceOp.AVG else op
+            work = self._comm.reduce_scatter(arrays, transport_op, owners)
+
+            def _normalize(f: Future) -> List[np.ndarray]:
+                self.metrics.observe(
+                    "allreduce", _time.perf_counter() - submit_time
+                )
+                reduced = list(f.result())
+                if op not in (ReduceOp.SUM, ReduceOp.AVG):
+                    return reduced
+                scale = 1.0 / max(1, self.num_participants())
+                # Owned arrays only: the rest are unspecified after a
+                # reduce_scatter (donation contract) — scaling them
+                # would be wasted work on garbage. Same per-element
+                # multiply as the allreduce path, so owned values stay
+                # bitwise aligned with it.
+                for i in owned:
+                    a = reduced[i]
+                    if _is_float_dtype(a.dtype):
+                        s = np.asarray(scale).astype(a.dtype)
+                        if a.flags.writeable:
+                            np.multiply(a, s, out=a)
+                        else:
+                            reduced[i] = a * s
+                return reduced
+
+            fut = future_chain(work.future(), _normalize)
+            return Work(self.wrap_future(fut, list(arrays)))
+        except Exception as e:  # noqa: BLE001
+            self._logger.exception(f"reduce_scatter submit failed: {e}")
+            self.report_error(e)
+            return CompletedWork(list(arrays))
+
+    def allgather_arrays(self, arrays: Sequence[np.ndarray]) -> Work:
+        """Manager-mediated allgather with the allreduce error model
+        (errors latched via report_error, never raised; the future
+        always completes — with ``[own arrays]`` as the degraded
+        default, i.e. a solo view). No participant scaling and no
+        zero-substitution: allgather carries STATE (updated param
+        shards, reshard manifests), and a healing member's contribution
+        is whatever the caller chose to advertise. Resolves to a list of
+        per-rank array lists, index-aligned with transport ranks."""
+        arrays = [np.asarray(a) for a in arrays]
+        fallback = [list(arrays)]
+        if self.errored() is not None:
+            return CompletedWork(fallback)
+        try:
+            self.wait_quorum()
+        except Exception as e:
+            self._logger.exception(f"quorum failed in allgather: {e}")
+            self.report_error(e)
+            return CompletedWork(fallback)
+        try:
+            work = self._comm.allgather(arrays)
+            return Work(self.wrap_future(work.future(), fallback))
+        except Exception as e:  # noqa: BLE001
+            self._logger.exception(f"allgather submit failed: {e}")
+            self.report_error(e)
+            return CompletedWork(fallback)
+
     def allreduce_pytree(self, tree: Any, op: str = ReduceOp.SUM) -> Future:
         """Reduce a pytree of jax/numpy arrays across replica groups.
 
@@ -1116,6 +1221,13 @@ class Manager:
         lacks: its single-replica jobs still run a loopback PG
         allreduce)."""
         return self._transport_world_size
+
+    def transport_rank(self) -> int:
+        """This replica's rank on the gradient wire for the current
+        quorum (the comm context's configured rank) — the rank whose
+        shard the sharded weight update owns. Valid after
+        ``wait_quorum``; 0 on a solo/observer wire."""
+        return int(self._comm.rank())
 
     def is_solo_wire(self) -> bool:
         """True when THIS quorum's wire is an identity for this replica:

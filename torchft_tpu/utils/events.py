@@ -26,6 +26,14 @@ Event vocabulary (producers in parentheses):
     mesh_reconfigure / mesh_compile  (comm/xla_backend.py: device mesh
                                       rebuilt for a new world size / an
                                       executable actually compiled)
+    shard_grid_rebuild               (ddp.py: the sharded-update leaf
+                                      grid rebuilt for a new wire world
+                                      size — old/new worlds attached)
+    reshard                          (optim.py/local_sgd.py: sharded
+                                      optimizer state redistributed at a
+                                      quorum boundary — old/new worlds,
+                                      moved/kept byte counts and any
+                                      reinitialized leaves attached)
 
 Every event is stamped with a process-monotonic sequence number, wall +
 monotonic clocks, the bound replica_id/rank, and (when the emitter knows
@@ -77,6 +85,8 @@ EVENT_KINDS = (
     "member_dead",
     "mesh_reconfigure",
     "mesh_compile",
+    "shard_grid_rebuild",
+    "reshard",
 )
 
 _DEFAULT_CAPACITY = 4096

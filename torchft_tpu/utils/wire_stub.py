@@ -24,7 +24,47 @@ from torchft_tpu.futures import future_chain
 from torchft_tpu.utils.events import EventRecorder
 from torchft_tpu.utils.metrics import Metrics
 
-__all__ = ["WireStubManager"]
+__all__ = ["WireStubManager", "run_stub_ranks"]
+
+
+def run_stub_ranks(store_addr: str, prefix: str, world: int, fn,
+                   ctx_factory, timeout: float = 120.0):
+    """Thread-per-rank loopback harness: one context per rank
+    (``ctx_factory()``), configured against ``store_addr/prefix``,
+    wrapped in a :class:`WireStubManager`, running ``fn(mgr, rank)``
+    concurrently. Returns the per-rank results; any rank's exception
+    aggregates into one RuntimeError; contexts always shut down.
+
+    THE shared scaffold for every single-process sharded/outer-round
+    harness (bench.py's sharded phase, scripts/bench_smoke.py,
+    scripts/bench_sharded.py) — the same drift argument as
+    WireStubManager itself: three hand-rolled copies of the
+    configure/thread/join/shutdown dance would diverge silently."""
+    import threading
+
+    ctxs = [ctx_factory() for _ in range(world)]
+    results = [None] * world
+    errors: "list[str]" = []
+
+    def _worker(rank: int) -> None:
+        try:
+            ctxs[rank].configure(f"{store_addr}/{prefix}", rank, world)
+            results[rank] = fn(WireStubManager(ctxs[rank], world), rank)
+        except Exception as e:  # noqa: BLE001 — aggregated below
+            errors.append(f"rank {rank}: {e!r}")
+
+    threads = [
+        threading.Thread(target=_worker, args=(r,)) for r in range(world)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    for ctx in ctxs:
+        ctx.shutdown()
+    if errors or any(r is None for r in results):
+        raise RuntimeError("; ".join(errors) or "a rank hung")
+    return results
 
 
 class WireStubManager:
@@ -97,6 +137,10 @@ class WireStubManager:
     def wire_nbytes(self, a) -> int:
         return self._ctx.wire_nbytes(a)
 
+    def transport_rank(self) -> int:
+        rank = getattr(self._ctx, "rank", None)
+        return int(rank()) if callable(rank) else 0
+
     def allreduce_arrays(self, arrays, op=ReduceOp.SUM) -> Work:
         work = self._ctx.allreduce(list(arrays), ReduceOp.SUM)
         scale = np.float32(1.0 / self._world)
@@ -109,3 +153,28 @@ class WireStubManager:
             return reduced
 
         return Work(future_chain(work.future(), _avg))
+
+    def reduce_scatter_arrays(self, arrays, op=ReduceOp.SUM,
+                              owners=None) -> Work:
+        """Same participant scaling as allreduce_arrays, applied to this
+        rank's OWNED arrays only (the rest are unspecified after a
+        reduce_scatter — the real manager's rule)."""
+        arrays = list(arrays)
+        if owners is None:
+            owners = [i % self._world for i in range(len(arrays))]
+        owners = [int(o) for o in owners]
+        work = self._ctx.reduce_scatter(arrays, ReduceOp.SUM, owners)
+        my = self.transport_rank()
+        scale = np.float32(1.0 / self._world)
+
+        def _avg(f: Future):
+            reduced = list(f.result())
+            for i, a in enumerate(reduced):
+                if owners[i] == my and a.dtype in (np.float32, np.float64):
+                    np.multiply(a, a.dtype.type(scale), out=a)
+            return reduced
+
+        return Work(future_chain(work.future(), _avg))
+
+    def allgather_arrays(self, arrays) -> Work:
+        return self._ctx.allgather(list(arrays))
