@@ -80,10 +80,16 @@ void ft_free(char* p) { free(p); }
 
 // ---------------------------------------------------------------- lighthouse
 
+// `extra_json` carries the fleet-scale options as an optional JSON blob
+// so the ABI stays stable as options grow:
+//   {"cache_quorum": bool, "prune_after_ms": int, "tier": int,
+//    "domain": str, "upstream_addr": str,
+//    "upstream_report_interval_ms": int}
+// NULL or "" keeps every default (cached decisions, root tier).
 void* ft_lighthouse_new(const char* bind_host, int port, const char* hostname,
                         uint64_t min_replicas, uint64_t join_timeout_ms,
                         uint64_t quorum_tick_ms, uint64_t heartbeat_timeout_ms,
-                        char** err) {
+                        const char* extra_json, char** err) {
   try {
     ftlighthouse::LighthouseOpts opts;
     opts.bind_host = bind_host ? bind_host : "0.0.0.0";
@@ -93,6 +99,16 @@ void* ft_lighthouse_new(const char* bind_host, int port, const char* hostname,
     opts.quorum.join_timeout_ms = join_timeout_ms;
     opts.quorum.quorum_tick_ms = quorum_tick_ms;
     opts.quorum.heartbeat_timeout_ms = heartbeat_timeout_ms;
+    if (extra_json != nullptr && extra_json[0] != '\0') {
+      auto extra = ftjson::Value::parse(extra_json);
+      opts.cache_quorum = extra.get_bool("cache_quorum", true);
+      opts.prune_after_ms = extra.get_int("prune_after_ms", 0);
+      opts.tier = static_cast<int>(extra.get_int("tier", -1));
+      opts.domain = extra.get_str("domain", "");
+      opts.upstream_addr = extra.get_str("upstream_addr", "");
+      opts.upstream_report_interval_ms = static_cast<uint64_t>(
+          extra.get_int("upstream_report_interval_ms", 500));
+    }
     auto lh = std::make_unique<ftlighthouse::Lighthouse>(std::move(opts));
     lh->start();
     return lh.release();
@@ -267,40 +283,62 @@ void ft_manager_client_free(void* handle) {
 }
 
 // --------------------------------------------------------- lighthouse client
+//
+// Persistent client handles: connections ride the process-wide keep-alive
+// pool (httpx.cc ConnPool) keyed by endpoint, so a long-lived handle's
+// heartbeats/quorums reuse one socket instead of reconnecting per call.
+// The one-shot ft_lighthouse_client_heartbeat/_quorum functions below are
+// kept as thin wrappers over a transient handle for compatibility.
 
-int ft_lighthouse_client_heartbeat(const char* lighthouse_addr,
-                                   const char* replica_id,
-                                   uint64_t timeout_ms, char** err) {
-  ClientHandle c;
-  c.addr = lighthouse_addr;
-  if (!fthttp::parse_http_addr(lighthouse_addr, &c.host, &c.port)) {
-    set_err(err, std::string("bad lighthouse address: ") + lighthouse_addr);
-    return -1;
-  }
-  ftjson::Object req;
-  req["replica_id"] = std::string(replica_id);
-  std::string out;
-  return client_post(&c, "/torchft.LighthouseService/Heartbeat",
-                     ftjson::Value(req).dump(),
-                     static_cast<int64_t>(timeout_ms), &out, err)
-             ? 0
-             : -1;
-}
-
-char* ft_lighthouse_client_quorum(const char* lighthouse_addr,
-                                  const char* requester_json,
-                                  uint64_t timeout_ms, char** err) {
-  ClientHandle c;
-  c.addr = lighthouse_addr;
-  if (!fthttp::parse_http_addr(lighthouse_addr, &c.host, &c.port)) {
-    set_err(err, std::string("bad lighthouse address: ") + lighthouse_addr);
+void* ft_lighthouse_client_new(const char* addr, char** err) {
+  auto* c = new ClientHandle();
+  c->addr = addr;
+  if (!fthttp::parse_http_addr(addr, &c->host, &c->port)) {
+    set_err(err, std::string("bad lighthouse address: ") + addr);
+    delete c;
     return nullptr;
   }
+  return c;
+}
+
+void ft_lighthouse_client_free(void* handle) {
+  delete static_cast<ClientHandle*>(handle);
+}
+
+// `ids_json`: either a JSON string ("replica_0") for the single-id form
+// or a JSON array (["a","b",...]) for one batched RPC carrying a whole
+// domain's heartbeats.
+int ft_lighthouse_client_heartbeat2(void* handle, const char* ids_json,
+                                    uint64_t timeout_ms, char** err) {
+  auto* c = static_cast<ClientHandle*>(handle);
+  try {
+    auto ids = ftjson::Value::parse(ids_json);
+    ftjson::Object req;
+    if (ids.is_string()) {
+      req["replica_id"] = ids.as_str();
+    } else {
+      req["replica_ids"] = std::move(ids);
+    }
+    std::string out;
+    return client_post(c, "/torchft.LighthouseService/Heartbeat",
+                       ftjson::Value(req).dump(),
+                       static_cast<int64_t>(timeout_ms), &out, err)
+               ? 0
+               : -1;
+  } catch (const std::exception& e) {
+    set_err(err, e.what());
+    return -1;
+  }
+}
+
+char* ft_lighthouse_client_quorum2(void* handle, const char* requester_json,
+                                   uint64_t timeout_ms, char** err) {
+  auto* c = static_cast<ClientHandle*>(handle);
   try {
     ftjson::Object req;
     req["requester"] = ftjson::Value::parse(requester_json);
     std::string out;
-    if (!client_post(&c, "/torchft.LighthouseService/Quorum",
+    if (!client_post(c, "/torchft.LighthouseService/Quorum",
                      ftjson::Value(req).dump(),
                      static_cast<int64_t>(timeout_ms), &out, err)) {
       return nullptr;
@@ -312,9 +350,39 @@ char* ft_lighthouse_client_quorum(const char* lighthouse_addr,
   }
 }
 
+int ft_lighthouse_client_heartbeat(const char* lighthouse_addr,
+                                   const char* replica_id,
+                                   uint64_t timeout_ms, char** err) {
+  ClientHandle c;
+  c.addr = lighthouse_addr;
+  if (!fthttp::parse_http_addr(lighthouse_addr, &c.host, &c.port)) {
+    set_err(err, std::string("bad lighthouse address: ") + lighthouse_addr);
+    return -1;
+  }
+  // JSON-encode the bare id into heartbeat2's single-id form so the
+  // Heartbeat wire shape lives in exactly one place.
+  std::string id_json = ftjson::Value(std::string(replica_id)).dump();
+  return ft_lighthouse_client_heartbeat2(&c, id_json.c_str(), timeout_ms,
+                                         err);
+}
+
+char* ft_lighthouse_client_quorum(const char* lighthouse_addr,
+                                  const char* requester_json,
+                                  uint64_t timeout_ms, char** err) {
+  ClientHandle c;
+  c.addr = lighthouse_addr;
+  if (!fthttp::parse_http_addr(lighthouse_addr, &c.host, &c.port)) {
+    set_err(err, std::string("bad lighthouse address: ") + lighthouse_addr);
+    return nullptr;
+  }
+  return ft_lighthouse_client_quorum2(&c, requester_json, timeout_ms, err);
+}
+
 // ------------------------------------------------------------- pure kernels
 // Exposed so the Python test suite can drive the decision kernels directly
 // (the reference tests its Rust kernels in-file; we test from pytest).
+
+static ftquorum::QuorumOpts parse_quorum_opts(const char* opts_json);
 
 char* ft_quorum_compute(int64_t now_ms, const char* state_json,
                         const char* opts_json, char** err) {
@@ -336,25 +404,11 @@ char* ft_quorum_compute(int64_t now_ms, const char* state_json,
       state.prev_quorum =
           ftquorum::QuorumInfo::from_json(state_v.get("prev_quorum"));
     }
-    auto opts_v = ftjson::Value::parse(opts_json);
-    ftquorum::QuorumOpts opts;
-    opts.min_replicas =
-        static_cast<uint64_t>(opts_v.get_int("min_replicas", 1));
-    opts.join_timeout_ms =
-        static_cast<uint64_t>(opts_v.get_int("join_timeout_ms", 60000));
-    opts.heartbeat_timeout_ms =
-        static_cast<uint64_t>(opts_v.get_int("heartbeat_timeout_ms", 5000));
+    auto opts = parse_quorum_opts(opts_json);
     auto decision = ftquorum::quorum_compute(now_ms, state, opts);
-    ftjson::Object out;
-    if (decision.quorum.has_value()) {
-      ftjson::Array members;
-      for (const auto& m : *decision.quorum) members.push_back(m.to_json());
-      out["quorum"] = ftjson::Value(std::move(members));
-    } else {
-      out["quorum"] = ftjson::Value(nullptr);
-    }
-    out["reason"] = decision.reason;
-    return dup_string(ftjson::Value(out).dump());
+    // decision_to_json is shared with ft_iq_decision: the byte-identity
+    // oracle between the incremental and from-scratch planes.
+    return dup_string(ftquorum::decision_to_json(decision));
   } catch (const std::exception& e) {
     set_err(err, e.what());
     return nullptr;
@@ -368,6 +422,139 @@ char* ft_compute_quorum_results(const char* replica_id, int64_t rank,
         ftquorum::QuorumInfo::from_json(ftjson::Value::parse(quorum_json));
     auto results = ftquorum::compute_quorum_results(replica_id, rank, quorum);
     return dup_string(results.to_json().dump());
+  } catch (const std::exception& e) {
+    set_err(err, e.what());
+    return nullptr;
+  }
+}
+
+// ------------------------------------------------- incremental quorum driver
+// Drives ftquorum::IncrementalQuorum directly from Python so the property
+// tests can replay arbitrary heartbeat/join/expiry/install sequences and
+// pin the incremental plane's decision JSON byte-identical to a
+// from-scratch ft_quorum_compute over the dumped state.
+
+static ftquorum::QuorumOpts parse_quorum_opts(const char* opts_json) {
+  auto opts_v = ftjson::Value::parse(opts_json);
+  ftquorum::QuorumOpts opts;
+  opts.min_replicas =
+      static_cast<uint64_t>(opts_v.get_int("min_replicas", 1));
+  opts.join_timeout_ms =
+      static_cast<uint64_t>(opts_v.get_int("join_timeout_ms", 60000));
+  opts.heartbeat_timeout_ms =
+      static_cast<uint64_t>(opts_v.get_int("heartbeat_timeout_ms", 5000));
+  return opts;
+}
+
+void* ft_iq_new(const char* opts_json, int incremental,
+                int64_t prune_after_ms, char** err) {
+  try {
+    return new ftquorum::IncrementalQuorum(parse_quorum_opts(opts_json),
+                                           incremental != 0, prune_after_ms);
+  } catch (const std::exception& e) {
+    set_err(err, e.what());
+    return nullptr;
+  }
+}
+
+void ft_iq_free(void* handle) {
+  delete static_cast<ftquorum::IncrementalQuorum*>(handle);
+}
+
+void ft_iq_heartbeat(void* handle, const char* replica_id, int64_t now_ms) {
+  static_cast<ftquorum::IncrementalQuorum*>(handle)->heartbeat(replica_id,
+                                                               now_ms);
+}
+
+int ft_iq_join(void* handle, int64_t joined_ms, const char* member_json,
+               char** err) {
+  try {
+    auto m = ftquorum::Member::from_json(ftjson::Value::parse(member_json));
+    static_cast<ftquorum::IncrementalQuorum*>(handle)->join(joined_ms, m);
+    return 0;
+  } catch (const std::exception& e) {
+    set_err(err, e.what());
+    return -1;
+  }
+}
+
+// Same {"quorum": [...]|null, "reason": str} shape (and bytes) as
+// ft_quorum_compute — decision_to_json is shared.
+char* ft_iq_decision(void* handle, int64_t now_ms, char** err) {
+  try {
+    auto* iq = static_cast<ftquorum::IncrementalQuorum*>(handle);
+    return dup_string(ftquorum::decision_to_json(iq->decision(now_ms)));
+  } catch (const std::exception& e) {
+    set_err(err, e.what());
+    return nullptr;
+  }
+}
+
+// Install the current decision as prev_quorum when ready (what the
+// lighthouse tick does on announcement). Returns
+// {"installed": bool, "quorum_id": int}.
+char* ft_iq_install(void* handle, int64_t now_ms, int64_t wall_ms,
+                    char** err) {
+  try {
+    auto* iq = static_cast<ftquorum::IncrementalQuorum*>(handle);
+    auto decision = iq->decision(now_ms);  // copy: install mutates state
+    ftjson::Object out;
+    if (decision.quorum.has_value()) {
+      const auto& q = iq->install(*decision.quorum, wall_ms);
+      out["installed"] = true;
+      out["quorum_id"] = q.quorum_id;
+    } else {
+      out["installed"] = false;
+      out["quorum_id"] = iq->quorum_id();
+    }
+    return dup_string(ftjson::Value(std::move(out)).dump());
+  } catch (const std::exception& e) {
+    set_err(err, e.what());
+    return nullptr;
+  }
+}
+
+// Dump the live QuorumState in exactly the shape ft_quorum_compute
+// parses, so the oracle recompute runs over the same inputs.
+char* ft_iq_state(void* handle, char** err) {
+  try {
+    auto* iq = static_cast<ftquorum::IncrementalQuorum*>(handle);
+    const auto& state = iq->state();
+    ftjson::Object o;
+    ftjson::Array parts;
+    for (const auto& kv : state.participants) {
+      ftjson::Object p;
+      p["joined_ms"] = kv.second.joined_ms;
+      p["member"] = kv.second.member.to_json();
+      parts.push_back(ftjson::Value(std::move(p)));
+    }
+    o["participants"] = ftjson::Value(std::move(parts));
+    ftjson::Object hbs;
+    for (const auto& kv : state.heartbeats) hbs[kv.first] = kv.second;
+    o["heartbeats"] = ftjson::Value(std::move(hbs));
+    o["prev_quorum"] = state.prev_quorum.has_value()
+                           ? state.prev_quorum->to_json()
+                           : ftjson::Value(nullptr);
+    return dup_string(ftjson::Value(std::move(o)).dump());
+  } catch (const std::exception& e) {
+    set_err(err, e.what());
+    return nullptr;
+  }
+}
+
+char* ft_iq_counters(void* handle, char** err) {
+  try {
+    auto* iq = static_cast<ftquorum::IncrementalQuorum*>(handle);
+    ftjson::Object o;
+    o["epoch"] = static_cast<int64_t>(iq->epoch());
+    o["compute_count"] = static_cast<int64_t>(iq->compute_count());
+    o["cache_hits"] = static_cast<int64_t>(iq->cache_hits());
+    o["pruned_heartbeats"] =
+        static_cast<int64_t>(iq->pruned_heartbeats());
+    o["pruned_participants"] =
+        static_cast<int64_t>(iq->pruned_participants());
+    o["healthy"] = static_cast<int64_t>(iq->healthy_count());
+    return dup_string(ftjson::Value(std::move(o)).dump());
   } catch (const std::exception& e) {
     set_err(err, e.what());
     return nullptr;

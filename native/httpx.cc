@@ -551,6 +551,7 @@ void HttpServer::serve_conn(int fd) {
   while (!stopping_.load()) {
     Request req;
     if (!read_request(rd, &req)) break;
+    req.client_fd = fd;
     Response resp;
     try {
       resp = handler_ ? handler_(req)

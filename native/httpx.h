@@ -29,6 +29,11 @@ struct Request {
   std::string body;
   std::map<std::string, std::string> headers;  // lowercase keys
   int64_t deadline_ms = 0;  // absolute (now_ms clock); always set by server
+  // The serving connection's fd (set by the server; -1 in tests that
+  // build Requests by hand). Long-poll handlers may PEEK it to detect a
+  // vanished client — a handler parked in a cv wait never reads the
+  // socket, so a disconnect is otherwise invisible until the wait ends.
+  int client_fd = -1;
 };
 
 struct Response {

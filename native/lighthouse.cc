@@ -1,7 +1,9 @@
 #include "lighthouse.h"
 
+#include <sys/socket.h>
 #include <unistd.h>
 
+#include <cerrno>
 #include <chrono>
 #include <cstdio>
 #include <sstream>
@@ -48,7 +50,13 @@ std::string html_escape(const std::string& s) {
 }  // namespace
 
 Lighthouse::Lighthouse(LighthouseOpts opts)
-    : opts_(std::move(opts)), server_(opts_.bind_host, opts_.port) {
+    : opts_(std::move(opts)),
+      server_(opts_.bind_host, opts_.port),
+      iq_(opts_.quorum, opts_.cache_quorum, opts_.prune_after_ms) {
+  if (opts_.tier < 0) opts_.tier = opts_.upstream_addr.empty() ? 0 : 1;
+  if (opts_.domain.empty() && opts_.tier > 0) {
+    opts_.domain = "domain:" + std::to_string(server_.port());
+  }
   server_.set_handler([this](const Request& req) { return handle(req); });
 }
 
@@ -84,38 +92,99 @@ std::string Lighthouse::address() const {
   return "http://" + host + ":" + std::to_string(server_.port());
 }
 
+std::string Lighthouse::build_domain_report_locked(int64_t now_ms) {
+  ftjson::Object o;
+  o["domain"] = opts_.domain;
+  o["tier"] = static_cast<int64_t>(opts_.tier);
+  o["address"] = address();
+  o["healthy"] = static_cast<int64_t>(iq_.healthy_count());
+  o["participants"] =
+      static_cast<int64_t>(iq_.state().participants.size());
+  int64_t quorum_id = 0;
+  int64_t max_step = 0;
+  if (iq_.state().prev_quorum.has_value()) {
+    const auto& q = *iq_.state().prev_quorum;
+    quorum_id = q.quorum_id;
+    for (const auto& p : q.participants)
+      max_step = std::max(max_step, p.step);
+  }
+  o["quorum_id"] = quorum_id;
+  o["max_step"] = max_step;
+  o["report_interval_ms"] =
+      static_cast<int64_t>(opts_.upstream_report_interval_ms);
+  (void)now_ms;
+  return ftjson::Value(std::move(o)).dump();
+}
+
 void Lighthouse::tick_loop() {
   std::unique_lock<std::mutex> lk(mu_);
+  int64_t last_report_ms = 0;
+  std::string up_host;
+  int up_port = 0;
+  bool up_ok = !opts_.upstream_addr.empty() &&
+               fthttp::parse_http_addr(opts_.upstream_addr, &up_host,
+                                       &up_port);
   while (!stopping_) {
     tick_locked();
+    // Evict domain rows silent far past their own advertised interval
+    // (well after the 3x staleness flag, so operators see the STALE row
+    // first): an aggregator restarting under a fresh generated domain
+    // name must not grow the root's map forever — the same monotonic-
+    // growth hygiene sweep() applies to heartbeats.
+    if (!domains_.empty()) {
+      int64_t now = fthttp::now_ms();
+      for (auto it = domains_.begin(); it != domains_.end();) {
+        int64_t expire =
+            std::max<int64_t>(20 * it->second.report_interval_ms, 3000);
+        if (now - it->second.received_ms > expire) {
+          it = domains_.erase(it);
+          domains_pruned_ += 1;
+        } else {
+          ++it;
+        }
+      }
+    }
+    if (up_ok) {
+      int64_t now = fthttp::now_ms();
+      int64_t interval =
+          static_cast<int64_t>(opts_.upstream_report_interval_ms);
+      if (now - last_report_ms >= interval) {
+        last_report_ms = now;
+        std::string body = build_domain_report_locked(now);
+        // Never post while holding the state lock; a slow/dead root
+        // must not block heartbeats or quorum RPCs.
+        lk.unlock();
+        fthttp::http_post(up_host, up_port,
+                          "/torchft.LighthouseService/DomainReport", body,
+                          fthttp::now_ms() + interval);
+        lk.lock();
+        if (stopping_) break;
+      }
+    }
     cv_.wait_for(lk, std::chrono::milliseconds(opts_.quorum.quorum_tick_ms),
                  [this] { return stopping_; });
   }
 }
 
 void Lighthouse::tick_locked() {
-  auto decision =
-      ftquorum::quorum_compute(fthttp::now_ms(), state_, opts_.quorum);
+  const auto& decision = iq_.decision(fthttp::now_ms());
   last_reason_ = decision.reason;
   if (!decision.quorum.has_value()) return;
 
-  // Bump the quorum id only when membership changed (ref lighthouse.rs
-  // 272-283); the id is what triggers transport reconfiguration downstream.
-  if (!state_.prev_quorum.has_value() ||
-      ftquorum::quorum_changed(*decision.quorum,
-                               state_.prev_quorum->participants)) {
-    quorum_id_ += 1;
+  // install() bumps the quorum id only when membership changed (ref
+  // lighthouse.rs 272-283); the id is what triggers transport
+  // reconfiguration downstream. It also clears participants — each
+  // quorum round requires a fresh request from every replica.
+  const QuorumInfo& q = iq_.install(*decision.quorum, wall_ms());
+  // Serialize the announcement ONCE; each of the n waiters ships these
+  // bytes verbatim instead of re-rendering an O(n) member list per RPC.
+  ftjson::Object reply;
+  reply["quorum"] = q.to_json();
+  latest_quorum_body_ = ftjson::Value(std::move(reply)).dump();
+  latest_quorum_ids_.clear();
+  for (const auto& p : q.participants) {
+    latest_quorum_ids_.insert(p.replica_id);
   }
-
-  QuorumInfo q;
-  q.quorum_id = quorum_id_;
-  q.participants = *decision.quorum;
-  q.created_ms = wall_ms();
-
-  state_.prev_quorum = q;
-  // Each quorum round requires a fresh request from every replica.
-  state_.participants.clear();
-  latest_quorum_ = q;
   quorum_seq_ += 1;
   cv_.notify_all();
 }
@@ -128,6 +197,10 @@ Response Lighthouse::handle(const Request& req) {
   if (req.path == "/torchft.LighthouseService/Heartbeat" &&
       req.method == "POST") {
     return handle_heartbeat(req);
+  }
+  if (req.path == "/torchft.LighthouseService/DomainReport" &&
+      req.method == "POST") {
+    return handle_domain_report(req);
   }
   if (req.path == "/status" && req.method == "GET") {
     return handle_status();
@@ -196,25 +269,54 @@ Response Lighthouse::handle_quorum(const Request& req) {
   }
 
   std::unique_lock<std::mutex> lk(mu_);
+  quorum_rpcs_ += 1;
   int64_t now = fthttp::now_ms();
   // Implicit heartbeat + join (ref lighthouse.rs:455-478).
-  state_.heartbeats[requester.replica_id] = now;
-  state_.participants[requester.replica_id] = {now, requester};
+  iq_.heartbeat(requester.replica_id, now);
+  iq_.join(now, requester);
   uint64_t seen = quorum_seq_;
-  tick_locked();  // proactive evaluation
+  tick_locked();  // proactive evaluation (a cache hit unless state moved)
+
+  // While parked, wake periodically to re-stamp our own heartbeat: a
+  // live long-poll IS a liveness signal, which is what lets the manager
+  // suppress separate heartbeat RPCs while its quorum request is in
+  // flight (the piggyback contract, native/manager.cc heartbeat_loop).
+  // The interval must stay safely below the heartbeat timeout — never
+  // stretched by a coarse quorum_tick_ms — or a parked waiter would
+  // expire between its own re-stamps.
+  const int64_t stamp_interval = std::max<int64_t>(
+      1, static_cast<int64_t>(opts_.quorum.heartbeat_timeout_ms) / 4);
 
   while (true) {
     while (quorum_seq_ == seen && !stopping_) {
-      auto deadline = std::chrono::steady_clock::now() +
-                      std::chrono::milliseconds(
-                          std::max<int64_t>(1, req.deadline_ms -
-                                                   fthttp::now_ms()));
+      int64_t now2 = fthttp::now_ms();
+      int64_t wake = std::min(req.deadline_ms, now2 + stamp_interval);
+      auto deadline =
+          std::chrono::steady_clock::now() +
+          std::chrono::milliseconds(std::max<int64_t>(1, wake - now2));
       if (cv_.wait_until(lk, deadline) == std::cv_status::timeout &&
           quorum_seq_ == seen) {
         if (fthttp::now_ms() >= req.deadline_ms) {
           return Response{504, "application/json",
                           "{\"error\":\"quorum deadline exceeded\"}"};
         }
+        // A DEAD long-poll is not a liveness signal: peek the serving
+        // socket before stamping — a parked handler never reads it, so
+        // a SIGKILLed client would otherwise look alive until the RPC
+        // deadline instead of expiring after heartbeat_timeout.
+        if (req.client_fd >= 0) {
+          char probe;
+          ssize_t pr = ::recv(req.client_fd, &probe, 1,
+                              MSG_PEEK | MSG_DONTWAIT);
+          if (pr == 0 || (pr < 0 && errno != EAGAIN &&
+                          errno != EWOULDBLOCK && errno != EINTR)) {
+            // Client vanished; stop stamping and let its heartbeat age
+            // out. The response write will fail harmlessly.
+            return Response{503, "application/json",
+                            "{\"error\":\"client disconnected\"}"};
+          }
+        }
+        iq_.heartbeat(requester.replica_id, fthttp::now_ms());
       }
     }
     if (stopping_) {
@@ -222,32 +324,57 @@ Response Lighthouse::handle_quorum(const Request& req) {
                       "{\"error\":\"lighthouse shutting down\"}"};
     }
     seen = quorum_seq_;
-    bool in_quorum = false;
-    for (const auto& p : latest_quorum_->participants) {
-      if (p.replica_id == requester.replica_id) {
-        in_quorum = true;
-        break;
-      }
-    }
-    if (in_quorum) break;
+    if (latest_quorum_ids_.count(requester.replica_id)) break;
     // Announced quorum doesn't include us: rejoin and wait for the next one
     // (ref lighthouse.rs:480-501).
     int64_t now2 = fthttp::now_ms();
-    state_.heartbeats[requester.replica_id] = now2;
-    state_.participants[requester.replica_id] = {now2, requester};
+    iq_.heartbeat(requester.replica_id, now2);
+    iq_.join(now2, requester);
   }
 
-  ftjson::Object reply;
-  reply["quorum"] = latest_quorum_->to_json();
-  return Response{200, "application/json", ftjson::Value(reply).dump()};
+  return Response{200, "application/json", latest_quorum_body_};
 }
 
 Response Lighthouse::handle_heartbeat(const Request& req) {
   try {
     auto body = ftjson::Value::parse(req.body);
-    std::string replica_id = body.get_str("replica_id");
+    int64_t now = fthttp::now_ms();
     std::lock_guard<std::mutex> lk(mu_);
-    state_.heartbeats[replica_id] = fthttp::now_ms();
+    heartbeat_rpcs_ += 1;
+    if (body.has("replica_ids")) {
+      // Batched form: one RPC carries a whole domain's heartbeats (the
+      // tier-1 aggregator path; proto LighthouseHeartbeatRequest).
+      for (const auto& v : body.get("replica_ids").as_array()) {
+        iq_.heartbeat(v.as_str(), now);
+        heartbeat_ids_ += 1;
+      }
+    } else {
+      iq_.heartbeat(body.get_str("replica_id"), now);
+      heartbeat_ids_ += 1;
+    }
+  } catch (const std::exception& e) {
+    return Response{400, "application/json",
+                    std::string("{\"error\":\"") + e.what() + "\"}"};
+  }
+  return Response{200, "application/json", "{}"};
+}
+
+Response Lighthouse::handle_domain_report(const Request& req) {
+  try {
+    auto body = ftjson::Value::parse(req.body);
+    DomainSummary s;
+    std::string domain = body.get_str("domain");
+    s.tier = body.get_int("tier", 1);
+    s.address = body.get_str("address", "");
+    s.healthy = body.get_int("healthy", 0);
+    s.participants = body.get_int("participants", 0);
+    s.quorum_id = body.get_int("quorum_id", 0);
+    s.max_step = body.get_int("max_step", 0);
+    s.report_interval_ms = body.get_int("report_interval_ms", 0);
+    s.received_ms = fthttp::now_ms();
+    std::lock_guard<std::mutex> lk(mu_);
+    domain_reports_ += 1;
+    domains_[domain] = std::move(s);
   } catch (const std::exception& e) {
     return Response{400, "application/json",
                     std::string("{\"error\":\"") + e.what() + "\"}"};
@@ -259,11 +386,16 @@ Response Lighthouse::handle_status() {
   std::ostringstream html;
   {
     std::lock_guard<std::mutex> lk(mu_);
-    auto decision =
-        ftquorum::quorum_compute(fthttp::now_ms(), state_, opts_.quorum);
-    html << "<p>quorum status: " << html_escape(decision.reason) << "</p>";
-    if (state_.prev_quorum.has_value()) {
-      const auto& q = *state_.prev_quorum;
+    const auto& decision = iq_.decision(fthttp::now_ms());
+    html << "<p>tier " << opts_.tier;
+    if (!opts_.domain.empty()) {
+      html << " &middot; domain " << html_escape(opts_.domain);
+    }
+    html << "</p><p>quorum status: " << html_escape(decision.reason)
+         << "</p>";
+    const auto& state = iq_.state();
+    if (state.prev_quorum.has_value()) {
+      const auto& q = *state.prev_quorum;
       int64_t max_step = 0;
       for (const auto& p : q.participants)
         max_step = std::max(max_step, p.step);
@@ -288,7 +420,7 @@ Response Lighthouse::handle_status() {
     }
     html << "<h3>heartbeats</h3><table><tr><th>replica</th><th>age</th></tr>";
     int64_t now = fthttp::now_ms();
-    for (const auto& hb : state_.heartbeats) {
+    for (const auto& hb : state.heartbeats) {
       bool dead = now - hb.second >=
                   static_cast<int64_t>(opts_.quorum.heartbeat_timeout_ms);
       html << "<tr class=\"" << (dead ? "dead" : "") << "\"><td>"
@@ -296,6 +428,17 @@ Response Lighthouse::handle_status() {
            << "ms</td></tr>";
     }
     html << "</table>";
+    if (!domains_.empty()) {
+      html << "<h3>domains</h3><table><tr><th>domain</th><th>healthy</th>"
+           << "<th>quorum id</th><th>report age</th></tr>";
+      for (const auto& kv : domains_) {
+        html << "<tr><td>" << html_escape(kv.first) << "</td><td>"
+             << kv.second.healthy << "</td><td>" << kv.second.quorum_id
+             << "</td><td>" << (now - kv.second.received_ms)
+             << "ms</td></tr>";
+      }
+      html << "</table>";
+    }
   }
   return Response{200, "text/html", html.str()};
 }
@@ -310,11 +453,12 @@ Response Lighthouse::handle_status_json() {
   {
     std::lock_guard<std::mutex> lk(mu_);
     int64_t now = fthttp::now_ms();
-    auto decision = ftquorum::quorum_compute(now, state_, opts_.quorum);
+    const auto& decision = iq_.decision(now);
     o["reason"] = decision.reason;
     o["now_ms"] = now;
-    if (state_.prev_quorum.has_value()) {
-      const auto& q = *state_.prev_quorum;
+    const auto& state = iq_.state();
+    if (state.prev_quorum.has_value()) {
+      const auto& q = *state.prev_quorum;
       o["quorum"] = q.to_json();
       o["quorum_age_ms"] = wall_ms() - q.created_ms;
       int64_t max_step = 0;
@@ -323,7 +467,7 @@ Response Lighthouse::handle_status_json() {
       o["max_step"] = max_step;
     }
     ftjson::Object hb;
-    for (const auto& h : state_.heartbeats) {
+    for (const auto& h : state.heartbeats) {
       ftjson::Object entry;
       entry["age_ms"] = now - h.second;
       entry["dead"] =
@@ -332,6 +476,53 @@ Response Lighthouse::handle_status_json() {
       hb[h.first] = ftjson::Value(std::move(entry));
     }
     o["heartbeats"] = ftjson::Value(std::move(hb));
+
+    // Control-plane scaling counters (PR 10): the evidence surface for
+    // "recompute count is O(membership changes), not O(RPCs)".
+    ftjson::Object ctl;
+    ctl["quorum_compute_count"] =
+        static_cast<int64_t>(iq_.compute_count());
+    ctl["quorum_cache_hits"] = static_cast<int64_t>(iq_.cache_hits());
+    ctl["membership_epoch"] = static_cast<int64_t>(iq_.epoch());
+    ctl["cache_enabled"] = iq_.incremental();
+    ctl["heartbeat_rpcs"] = static_cast<int64_t>(heartbeat_rpcs_);
+    ctl["heartbeat_ids"] = static_cast<int64_t>(heartbeat_ids_);
+    ctl["quorum_rpcs"] = static_cast<int64_t>(quorum_rpcs_);
+    ctl["domain_reports"] = static_cast<int64_t>(domain_reports_);
+    ctl["domains_pruned"] = static_cast<int64_t>(domains_pruned_);
+    ctl["heartbeats_pruned"] =
+        static_cast<int64_t>(iq_.pruned_heartbeats());
+    ctl["participants_pruned"] =
+        static_cast<int64_t>(iq_.pruned_participants());
+    ctl["healthy_replicas"] = static_cast<int64_t>(iq_.healthy_count());
+    ctl["tier"] = static_cast<int64_t>(opts_.tier);
+    ctl["domain"] = opts_.domain;
+    ctl["upstream"] = opts_.upstream_addr;
+    o["control"] = ftjson::Value(std::move(ctl));
+
+    // Root side of the two-level tree: one summary row per reporting
+    // domain aggregator, with report staleness derived from the
+    // aggregator's own advertised interval.
+    if (!domains_.empty()) {
+      ftjson::Object doms;
+      for (const auto& kv : domains_) {
+        const DomainSummary& s = kv.second;
+        ftjson::Object d;
+        d["tier"] = s.tier;
+        d["address"] = s.address;
+        d["healthy"] = s.healthy;
+        d["participants"] = s.participants;
+        d["quorum_id"] = s.quorum_id;
+        d["max_step"] = s.max_step;
+        d["report_interval_ms"] = s.report_interval_ms;
+        int64_t age = now - s.received_ms;
+        d["report_age_ms"] = age;
+        d["stale"] =
+            s.report_interval_ms > 0 && age > 3 * s.report_interval_ms;
+        doms[kv.first] = ftjson::Value(std::move(d));
+      }
+      o["domains"] = ftjson::Value(std::move(doms));
+    }
   }
   return Response{200, "application/json", ftjson::Value(std::move(o)).dump()};
 }
@@ -340,10 +531,11 @@ Response Lighthouse::handle_kill(const std::string& replica_id) {
   std::string manager_addr;
   {
     std::lock_guard<std::mutex> lk(mu_);
-    if (!state_.prev_quorum.has_value()) {
+    const auto& state = iq_.state();
+    if (!state.prev_quorum.has_value()) {
       return Response{500, "text/plain", "failed to find replica"};
     }
-    for (const auto& m : state_.prev_quorum->participants) {
+    for (const auto& m : state.prev_quorum->participants) {
       if (m.replica_id == replica_id) {
         manager_addr = m.address;
         break;

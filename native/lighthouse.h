@@ -2,22 +2,43 @@
 //
 // Global quorum service (reference: /root/reference/src/lighthouse.rs).
 // Serves, on one port:
-//   POST /torchft.LighthouseService/Quorum     (long-poll until quorum)
-//   POST /torchft.LighthouseService/Heartbeat
+//   POST /torchft.LighthouseService/Quorum       (long-poll until quorum)
+//   POST /torchft.LighthouseService/Heartbeat    (single id or batched
+//                                                 replica_ids list)
+//   POST /torchft.LighthouseService/DomainReport (tier-1 aggregator ->
+//                                                 root membership summary)
 //   GET  /            dashboard HTML
 //   GET  /status      dashboard fragment (polled by the dashboard JS)
 //   GET  /status.json machine-readable fleet status (quorum members with
 //                     manager/store addresses + per-replica heartbeat
-//                     ages) — the discovery root for scripts/fleet_top.py
+//                     ages + "control" counters + "domains" tree) — the
+//                     discovery root for scripts/fleet_top.py
 //   POST /replica/{id}/kill   proxies a Kill RPC to that replica's manager
 //
 // Design: one mutex + condition_variable guard all state; the quorum RPC
 // long-polls on a monotonically increasing quorum sequence number (the
 // C++ rendering of the reference's tokio broadcast channel); a tick thread
-// re-evaluates the decision kernel every quorum_tick_ms.
+// re-evaluates the decision every quorum_tick_ms.
+//
+// Fleet scale (PR 10): quorum state lives in an IncrementalQuorum —
+// decisions are cached per membership epoch so a round at n replica
+// groups costs O(n) recomputes (one per join edge) instead of O(n^2)
+// full scans, the announced quorum's response JSON and id-set are
+// serialized once per announcement and served verbatim to every waiter,
+// and a parked long-poll waiter is periodically re-stamped as alive so
+// managers can suppress their separate heartbeat RPCs while a quorum
+// request is in flight (the piggyback path, native/manager.cc).
+//
+// Two-level tree: a lighthouse constructed with an upstream address is a
+// tier-1 aggregator for a domain (rack/ICI) of replica groups — it holds
+// the quorum for that domain and reports ONE membership summary upstream
+// per report interval; the root renders the summaries in /status.json
+// ("domains", with report staleness) without tracking any per-replica
+// state for foreign domains.
 #pragma once
 
 #include <condition_variable>
+#include <map>
 #include <mutex>
 #include <optional>
 #include <string>
@@ -33,6 +54,31 @@ struct LighthouseOpts {
   int port = 0;                  // 0 = ephemeral
   std::string hostname = "";     // advertised host; "" = bind_host or 127.0.0.1
   ftquorum::QuorumOpts quorum;
+  // -- fleet-scale options --
+  // Serve epoch-cached decisions (true) or run the pure kernel on every
+  // evaluation (false — the always-recompute A/B arm of bench_fleet.py).
+  bool cache_quorum = true;
+  // Heartbeat/participant entries dead for longer than this are pruned
+  // (<=0: IncrementalQuorum's default of 12x heartbeat_timeout_ms).
+  int64_t prune_after_ms = 0;
+  // Topology tier label: 0 = root, 1 = domain aggregator. Derived from
+  // upstream_addr when left at -1.
+  int tier = -1;
+  std::string domain = "";         // domain (rack/ICI) name, "" = unnamed
+  std::string upstream_addr = "";  // root lighthouse; "" = this IS the root
+  uint64_t upstream_report_interval_ms = 500;
+};
+
+// One aggregator's latest upstream summary, as stored by the root.
+struct DomainSummary {
+  int64_t tier = 1;
+  std::string address;
+  int64_t healthy = 0;
+  int64_t participants = 0;
+  int64_t quorum_id = 0;
+  int64_t max_step = 0;
+  int64_t report_interval_ms = 0;
+  int64_t received_ms = 0;  // monotonic, root's clock
 };
 
 class Lighthouse {
@@ -49,13 +95,16 @@ class Lighthouse {
   fthttp::Response handle(const fthttp::Request& req);
   fthttp::Response handle_quorum(const fthttp::Request& req);
   fthttp::Response handle_heartbeat(const fthttp::Request& req);
+  fthttp::Response handle_domain_report(const fthttp::Request& req);
   fthttp::Response handle_status();
   fthttp::Response handle_status_json();
   fthttp::Response handle_kill(const std::string& replica_id);
-  // Runs the decision kernel; on success publishes a new quorum and wakes
-  // waiters. Caller must hold mu_.
+  // Runs the (cached) decision; on success publishes a new quorum — one
+  // serialization, one id-set — and wakes waiters. Caller must hold mu_.
   void tick_locked();
   void tick_loop();
+  // Build the upstream DomainReport body from current state (holds mu_).
+  std::string build_domain_report_locked(int64_t now_ms);
 
   LighthouseOpts opts_;
   fthttp::HttpServer server_;
@@ -63,12 +112,28 @@ class Lighthouse {
 
   mutable std::mutex mu_;
   std::condition_variable cv_;
-  ftquorum::QuorumState state_;
-  int64_t quorum_id_ = 0;
+  ftquorum::IncrementalQuorum iq_;
   uint64_t quorum_seq_ = 0;
-  std::optional<ftquorum::QuorumInfo> latest_quorum_;
+  // Serialized once per announcement (the installed quorum itself lives
+  // in iq_.state().prev_quorum); every waiter ships these bytes
+  // verbatim instead of re-serializing an O(n) member list per RPC.
+  std::string latest_quorum_body_;
+  std::set<std::string> latest_quorum_ids_;
   std::string last_reason_;
   bool stopping_ = false;
+
+  // RPC counters (monotonic; surfaced under /status.json "control").
+  uint64_t heartbeat_rpcs_ = 0;
+  uint64_t heartbeat_ids_ = 0;  // replica ids carried by those RPCs
+  uint64_t quorum_rpcs_ = 0;
+  uint64_t domain_reports_ = 0;
+  uint64_t domains_pruned_ = 0;
+
+  // Root side of the two-level tree: domain name -> latest summary.
+  // Rows silent for far longer than their advertised interval are
+  // evicted by the tick loop (counted above) so aggregator restarts
+  // under generated domain names can't grow this map forever.
+  std::map<std::string, DomainSummary> domains_;
 };
 
 }  // namespace ftlighthouse

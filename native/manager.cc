@@ -66,10 +66,25 @@ void ManagerServer::heartbeat_loop() {
   std::string body = ftjson::Value(hb).dump();
   std::unique_lock<std::mutex> lk(mu_);
   while (!stopping_) {
-    lk.unlock();
-    fthttp::http_post(host, port, "/torchft.LighthouseService/Heartbeat",
-                      body, fthttp::now_ms() + 5000);
-    lk.lock();
+    // Piggyback: an outstanding lighthouse quorum RPC is itself a
+    // liveness signal (the lighthouse re-stamps parked long-poll waiters
+    // periodically), and any recent lighthouse contact makes a separate
+    // heartbeat redundant for this interval. In a steady training loop,
+    // where a quorum RPC is in flight at every step boundary, this is
+    // what collapses per-replica heartbeat traffic.
+    bool skip = lighthouse_inflight_ > 0 ||
+                fthttp::now_ms() - last_lighthouse_contact_ms_ <
+                    static_cast<int64_t>(opts_.heartbeat_interval_ms);
+    if (!skip) {
+      lk.unlock();
+      auto res = fthttp::http_post(
+          host, port, "/torchft.LighthouseService/Heartbeat", body,
+          fthttp::now_ms() + 5000);
+      lk.lock();
+      if (res.error.empty() && res.status == 200) {
+        last_lighthouse_contact_ms_ = fthttp::now_ms();
+      }
+    }
     cv_.wait_for(lk,
                  std::chrono::milliseconds(opts_.heartbeat_interval_ms),
                  [this] { return stopping_; });
@@ -132,6 +147,7 @@ Response ManagerServer::handle_quorum(const Request& req) {
       self.comm_epoch = std::max(self.comm_epoch, kv.second);
     }
 
+    lighthouse_inflight_ += 1;  // heartbeat loop piggybacks on this RPC
     lk.unlock();
     std::string host;
     int port = 0;
@@ -143,6 +159,10 @@ Response ManagerServer::handle_quorum(const Request& req) {
                                  ftjson::Value(lh_req).dump(),
                                  req.deadline_ms);
     lk.lock();
+    lighthouse_inflight_ -= 1;
+    if (res.error.empty() && res.status == 200) {
+      last_lighthouse_contact_ms_ = fthttp::now_ms();
+    }
     if (!res.error.empty() || res.status != 200) {
       std::string msg = !res.error.empty()
                             ? res.error

@@ -73,6 +73,16 @@ class ManagerServer {
   std::condition_variable cv_;
   bool stopping_ = false;
 
+  // Heartbeat piggybacking on in-flight Quorum RPCs: while a lighthouse
+  // quorum request is outstanding the server re-stamps this replica's
+  // heartbeat from the parked long-poll itself (lighthouse.cc
+  // handle_quorum), so the heartbeat loop skips its separate RPC — at
+  // fleet scale this is where most steady-state heartbeat traffic goes.
+  // (Observability lives server-side: the lighthouse's heartbeat_rpcs
+  // counter in /status.json is the auditable surface.)
+  int lighthouse_inflight_ = 0;
+  int64_t last_lighthouse_contact_ms_ = 0;
+
   // Quorum fan-in state.
   std::map<int64_t, std::string> checkpoint_metadata_;
   std::set<int64_t> participants_;

@@ -66,6 +66,66 @@ bool quorum_changed(const std::vector<Member>& a,
   return false;
 }
 
+std::string quorum_meta(size_t healthy_participants, size_t participants,
+                        size_t healthy_replicas, bool shrink_only) {
+  std::ostringstream meta;
+  meta << "[" << healthy_participants << "/" << participants
+       << " participants healthy][" << healthy_replicas
+       << " heartbeating][shrink_only=" << (shrink_only ? "true" : "false")
+       << "]";
+  return meta.str();
+}
+
+std::string reason_fast(const std::string& meta) {
+  return "Fast quorum found! " + meta;
+}
+
+std::string reason_min_replicas(size_t healthy_participants,
+                                uint64_t min_replicas,
+                                const std::string& meta) {
+  std::ostringstream r;
+  r << "New quorum not ready, only have " << healthy_participants
+    << " participants, need min_replicas " << min_replicas << " " << meta;
+  return r.str();
+}
+
+std::string reason_split_brain(size_t healthy_participants,
+                               size_t healthy_replicas,
+                               const std::string& meta) {
+  std::ostringstream r;
+  r << "New quorum not ready, only have " << healthy_participants
+    << " participants, need at least half of " << healthy_replicas
+    << " healthy workers " << meta;
+  return r.str();
+}
+
+std::string reason_stragglers(size_t healthy_participants, size_t stragglers,
+                              const std::string& meta) {
+  std::ostringstream r;
+  r << "Valid quorum with " << healthy_participants
+    << " participants, waiting for " << stragglers
+    << " healthy but not participating stragglers due to join timeout "
+    << meta;
+  return r.str();
+}
+
+std::string reason_valid(const std::string& meta) {
+  return "Valid quorum found " + meta;
+}
+
+std::string decision_to_json(const QuorumDecision& d) {
+  ftjson::Object out;
+  if (d.quorum.has_value()) {
+    ftjson::Array members;
+    for (const auto& m : *d.quorum) members.push_back(m.to_json());
+    out["quorum"] = ftjson::Value(std::move(members));
+  } else {
+    out["quorum"] = ftjson::Value(nullptr);
+  }
+  out["reason"] = d.reason;
+  return ftjson::Value(std::move(out)).dump();
+}
+
 QuorumDecision quorum_compute(int64_t now_ms, const QuorumState& state,
                               const QuorumOpts& opts) {
   // A replica is healthy iff its last heartbeat is fresh.
@@ -98,11 +158,9 @@ QuorumDecision quorum_compute(int64_t now_ms, const QuorumState& state,
     if (d->member.shrink_only) shrink_only = true;
   }
 
-  std::ostringstream meta;
-  meta << "[" << healthy_participants.size() << "/"
-       << state.participants.size() << " participants healthy]["
-       << healthy_replicas.size() << " heartbeating][shrink_only="
-       << (shrink_only ? "true" : "false") << "]";
+  std::string meta =
+      quorum_meta(healthy_participants.size(), state.participants.size(),
+                  healthy_replicas.size(), shrink_only);
 
   if (state.prev_quorum.has_value()) {
     const QuorumInfo& prev = *state.prev_quorum;
@@ -131,26 +189,22 @@ QuorumDecision quorum_compute(int64_t now_ms, const QuorumState& state,
       }
     }
     if (is_fast) {
-      return {candidates, "Fast quorum found! " + meta.str()};
+      return {candidates, reason_fast(meta)};
     }
   }
 
   if (healthy_participants.size() < opts.min_replicas) {
-    std::ostringstream r;
-    r << "New quorum not ready, only have " << healthy_participants.size()
-      << " participants, need min_replicas " << opts.min_replicas << " "
-      << meta.str();
-    return {std::nullopt, r.str()};
+    return {std::nullopt,
+            reason_min_replicas(healthy_participants.size(),
+                                opts.min_replicas, meta)};
   }
 
   // Split-brain guard: require a strict majority of the healthy heartbeaters
   // to be participating before forming a quorum without them.
   if (healthy_participants.size() <= healthy_replicas.size() / 2) {
-    std::ostringstream r;
-    r << "New quorum not ready, only have " << healthy_participants.size()
-      << " participants, need at least half of " << healthy_replicas.size()
-      << " healthy workers " << meta.str();
-    return {std::nullopt, r.str()};
+    return {std::nullopt,
+            reason_split_brain(healthy_participants.size(),
+                               healthy_replicas.size(), meta)};
   }
 
   bool all_healthy_joined =
@@ -161,16 +215,241 @@ QuorumDecision quorum_compute(int64_t now_ms, const QuorumState& state,
   }
   if (!all_healthy_joined &&
       now_ms - first_joined < static_cast<int64_t>(opts.join_timeout_ms)) {
-    std::ostringstream r;
-    r << "Valid quorum with " << healthy_participants.size()
-      << " participants, waiting for "
-      << (healthy_replicas.size() - healthy_participants.size())
-      << " healthy but not participating stragglers due to join timeout "
-      << meta.str();
-    return {std::nullopt, r.str()};
+    return {std::nullopt,
+            reason_stragglers(
+                healthy_participants.size(),
+                healthy_replicas.size() - healthy_participants.size(),
+                meta)};
   }
 
-  return {candidates, "Valid quorum found " + meta.str()};
+  return {candidates, reason_valid(meta)};
+}
+
+// ------------------------------------------------------ IncrementalQuorum
+
+namespace {
+constexpr int64_t kNever = INT64_MAX;
+}  // namespace
+
+IncrementalQuorum::IncrementalQuorum(QuorumOpts opts, bool incremental,
+                                     int64_t prune_after_ms)
+    : opts_(opts),
+      incremental_(incremental),
+      prune_after_ms_(
+          prune_after_ms > 0
+              ? prune_after_ms
+              : 12 * static_cast<int64_t>(opts.heartbeat_timeout_ms)) {}
+
+void IncrementalQuorum::add_healthy_participant(
+    const ParticipantDetails& d) {
+  hp_count_ += 1;
+  if (d.member.shrink_only) hp_shrink_count_ += 1;
+  if (prev_ids_.count(d.member.replica_id)) prev_present_ += 1;
+  if (!first_dirty_) {
+    hp_first_joined_ = std::min(hp_first_joined_, d.joined_ms);
+  }
+}
+
+void IncrementalQuorum::remove_healthy_participant(
+    const ParticipantDetails& d) {
+  hp_count_ -= 1;
+  if (d.member.shrink_only) hp_shrink_count_ -= 1;
+  if (prev_ids_.count(d.member.replica_id)) prev_present_ -= 1;
+  // Removing the min holder invalidates the maintained min; removals are
+  // membership-change edges (rare), so the lazy O(n) recompute on the
+  // next decision is bounded by the same edge count as the recompute
+  // itself.
+  if (!first_dirty_ && d.joined_ms == hp_first_joined_) first_dirty_ = true;
+}
+
+int64_t IncrementalQuorum::first_joined(int64_t now_ms) {
+  if (first_dirty_) {
+    hp_first_joined_ = kNever;
+    for (const auto& kv : state_.participants) {
+      if (healthy_.count(kv.first)) {
+        hp_first_joined_ = std::min(hp_first_joined_, kv.second.joined_ms);
+      }
+    }
+    first_dirty_ = false;
+  }
+  return std::min(now_ms, hp_first_joined_);
+}
+
+void IncrementalQuorum::heartbeat(const std::string& replica_id,
+                                  int64_t now_ms) {
+  state_.heartbeats[replica_id] = now_ms;
+  // Keep the expiry watermark conservative: this entry expires at
+  // now+timeout, which may be earlier than whatever the last sweep saw
+  // (in particular after a sweep over an empty/fully-pruned table).
+  next_expiry_ms_ = std::min(
+      next_expiry_ms_,
+      now_ms + static_cast<int64_t>(opts_.heartbeat_timeout_ms));
+  if (healthy_.insert(replica_id).second) {
+    // dead->alive (or first sighting): a decision input changed.
+    epoch_ += 1;
+    auto it = state_.participants.find(replica_id);
+    if (it != state_.participants.end()) add_healthy_participant(it->second);
+  }
+  // alive->alive refresh: no epoch bump — the decision is a function of
+  // the healthy SET, not of heartbeat ages.
+}
+
+void IncrementalQuorum::join(int64_t joined_ms, const Member& m) {
+  auto it = state_.participants.find(m.replica_id);
+  bool healthy = healthy_.count(m.replica_id) > 0;
+  if (it != state_.participants.end()) {
+    if (healthy) remove_healthy_participant(it->second);
+    it->second.joined_ms = joined_ms;
+    it->second.member = m;
+    if (healthy) add_healthy_participant(it->second);
+  } else {
+    ParticipantDetails d;
+    d.joined_ms = joined_ms;
+    d.member = m;
+    auto ins = state_.participants.emplace(m.replica_id, std::move(d));
+    if (healthy) add_healthy_participant(ins.first->second);
+  }
+  // The member payload (step, shrink_only, comm_epoch...) rides into the
+  // decision's candidate list, so every (re)join is decision-relevant.
+  epoch_ += 1;
+}
+
+void IncrementalQuorum::sweep(int64_t now_ms) {
+  if (now_ms < next_expiry_ms_ && now_ms < next_prune_ms_) return;
+  const int64_t hb_timeout =
+      static_cast<int64_t>(opts_.heartbeat_timeout_ms);
+  next_expiry_ms_ = kNever;
+  next_prune_ms_ = kNever;
+  for (auto it = state_.heartbeats.begin();
+       it != state_.heartbeats.end();) {
+    int64_t age = now_ms - it->second;
+    if (age < hb_timeout) {
+      next_expiry_ms_ = std::min(next_expiry_ms_, it->second + hb_timeout);
+      ++it;
+      continue;
+    }
+    // alive->dead edge.
+    if (healthy_.erase(it->first)) {
+      epoch_ += 1;
+      auto pit = state_.participants.find(it->first);
+      if (pit != state_.participants.end()) {
+        remove_healthy_participant(pit->second);
+      }
+    }
+    if (age >= prune_after_ms_) {
+      // Long-dead: drop the heartbeat entry AND any stale participant
+      // record so neither the decision scan nor /status.json grows
+      // monotonically across churn. A pruned replica that comes back
+      // simply re-registers via heartbeat + join.
+      auto pit = state_.participants.find(it->first);
+      if (pit != state_.participants.end()) {
+        state_.participants.erase(pit);
+        pruned_participants_ += 1;
+        // participants.size() appears in the decision meta string.
+        epoch_ += 1;
+      }
+      pruned_heartbeats_ += 1;
+      it = state_.heartbeats.erase(it);
+    } else {
+      next_prune_ms_ = std::min(next_prune_ms_, it->second + prune_after_ms_);
+      ++it;
+    }
+  }
+}
+
+std::vector<Member> IncrementalQuorum::materialize(
+    bool shrink_filter) const {
+  std::vector<Member> out;
+  out.reserve(hp_count_);
+  // The participant map iterates in replica_id order — exactly the
+  // kernel's sorted candidate order.
+  for (const auto& kv : state_.participants) {
+    if (!healthy_.count(kv.first)) continue;
+    if (shrink_filter && !prev_ids_.count(kv.first)) continue;
+    out.push_back(kv.second.member);
+  }
+  return out;
+}
+
+void IncrementalQuorum::evaluate(int64_t now_ms) {
+  const size_t hp = hp_count_;
+  const size_t hb = healthy_.size();
+  const bool shrink = hp_shrink_count_ > 0;
+  const bool has_prev = state_.prev_quorum.has_value();
+  std::string meta =
+      quorum_meta(hp, state_.participants.size(), hb, shrink);
+  cache_deadline_ms_ = kNever;
+
+  if (has_prev && prev_present_ == prev_ids_.size()) {
+    cached_ = {materialize(shrink), reason_fast(meta)};
+    return;
+  }
+  if (hp < opts_.min_replicas) {
+    cached_ = {std::nullopt, reason_min_replicas(hp, opts_.min_replicas,
+                                                 meta)};
+    return;
+  }
+  if (hp <= hb / 2) {
+    cached_ = {std::nullopt, reason_split_brain(hp, hb, meta)};
+    return;
+  }
+  if (hp != hb) {
+    int64_t first = first_joined(now_ms);
+    int64_t matures = first + static_cast<int64_t>(opts_.join_timeout_ms);
+    if (now_ms < matures) {
+      cached_ = {std::nullopt, reason_stragglers(hp, hb - hp, meta)};
+      // The only decision transition driven purely by time passing with
+      // no state edge: the join timeout maturing.
+      cache_deadline_ms_ = matures;
+      return;
+    }
+  }
+  cached_ = {materialize(shrink && has_prev), reason_valid(meta)};
+}
+
+const QuorumDecision& IncrementalQuorum::decision(int64_t now_ms) {
+  sweep(now_ms);  // may bump epoch_ on expiry/prune edges
+  if (cache_valid_ && cache_epoch_ == epoch_ &&
+      now_ms < cache_deadline_ms_) {
+    cache_hits_ += 1;
+    return cached_;
+  }
+  compute_count_ += 1;
+  if (incremental_) {
+    evaluate(now_ms);
+  } else {
+    cached_ = quorum_compute(now_ms, state_, opts_);
+    cache_deadline_ms_ = 0;  // always-recompute arm: never serve cached
+  }
+  cache_valid_ = incremental_;
+  cache_epoch_ = epoch_;
+  return cached_;
+}
+
+const QuorumInfo& IncrementalQuorum::install(
+    const std::vector<Member>& members, int64_t created_wall_ms) {
+  if (!state_.prev_quorum.has_value() ||
+      quorum_changed(members, state_.prev_quorum->participants)) {
+    quorum_id_ += 1;
+  }
+  QuorumInfo q;
+  q.quorum_id = quorum_id_;
+  q.participants = members;
+  q.created_ms = created_wall_ms;
+  state_.prev_quorum = std::move(q);
+
+  prev_ids_.clear();
+  for (const auto& p : state_.prev_quorum->participants) {
+    prev_ids_.insert(p.replica_id);
+  }
+  // Each round requires a fresh request from every replica.
+  state_.participants.clear();
+  hp_count_ = 0;
+  hp_shrink_count_ = 0;
+  prev_present_ = 0;
+  first_dirty_ = true;
+  epoch_ += 1;
+  return *state_.prev_quorum;
 }
 
 ftjson::Value QuorumResults::to_json() const {

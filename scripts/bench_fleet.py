@@ -1,0 +1,407 @@
+#!/usr/bin/env python
+"""bench_fleet: control-plane scale sweep over simulated replica groups.
+
+The first BENCH curve vs *scale* rather than payload size: for each world
+size (16 -> 256 groups by default, 512 via --worlds), threads posting REAL
+HTTP to a live native lighthouse measure the quorum-formation trajectory,
+the recompute-vs-RPC counter split, and heartbeat RPC volume:
+
+- **cached vs recompute A/B** (rep-interleaved): the same round driven
+  against a ``cache_quorum=True`` lighthouse (epoch-cached incremental
+  decisions — the shipped default) and a ``cache_quorum=False`` one (the
+  pure kernel on every evaluation — the pre-PR-10 plane). Both arms are
+  committed to the artifact.
+- **per-replica vs batched+piggyback heartbeat A/B**: a steady window
+  where every group posts its own heartbeat per interval (the old
+  manager path), vs one where half the fleet is parked on an in-flight
+  quorum long-poll posting NO heartbeats for ~1.25x the heartbeat
+  timeout — so the liveness oracle (every group still healthy at window
+  end) is SHARP: it fails unless the server-side waiter re-stamp (the
+  piggyback mechanism) is actually keeping the parked half alive. The
+  unparked rest are covered by per-domain batch RPCs of --batch ids
+  each (the tier-1 aggregator path).
+- **decision-equality oracle**: the formation sequence is replayed
+  in-process through the incremental evaluator AND the pure kernel; the
+  decision JSON must be byte-identical at every step — a single
+  mismatched byte fails the rep. Server-arm responses are additionally
+  cross-checked (normalized for created_ms, which is wall clock).
+
+Counters come from the lighthouse's own /status.json "control" object
+(quorum_compute_count / quorum_cache_hits / heartbeat_rpcs / ...), so
+the evidence is deterministic RPC/recompute accounting, not wall clock —
+the honest currency on a 2-core sandbox (ROADMAP re-anchor note).
+
+    python scripts/bench_fleet.py --out docs/evidence/bench_fleet_r13.json
+    python scripts/bench_fleet.py --worlds 16,64,256 --reps 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from torchft_tpu.control import (  # noqa: E402
+    IncrementalQuorum,
+    Lighthouse,
+    LighthouseClient,
+    quorum_compute_raw,
+)
+
+OPTS = {
+    "min_replicas": 1,  # overridden per world
+    "join_timeout_ms": 60000,
+    # Short enough that the steady window's parked half genuinely
+    # outlives it (the liveness oracle is sharp: survival REQUIRES the
+    # server-side long-poll re-stamp), long enough that a 512-group
+    # formation round (~1s of joins) can't expire early joiners.
+    "heartbeat_timeout_ms": 2000,
+}
+
+
+def _member(i: int, step: int = 0) -> Dict[str, Any]:
+    return {
+        "replica_id": f"grp_{i:04d}",
+        "address": f"http://mgr{i}:1",
+        "store_address": f"store{i}:1",
+        "step": step,
+        "world_size": 1,
+        "shrink_only": False,
+    }
+
+
+def _status(addr: str, timeout: float = 10.0) -> Dict[str, Any]:
+    with urllib.request.urlopen(addr + "/status.json", timeout=timeout) as r:
+        return json.load(r)
+
+
+def _control(addr: str) -> Dict[str, Any]:
+    return _status(addr)["control"]
+
+
+def oracle_replay(world: int) -> Dict[str, Any]:
+    """Replay a formation + steady + second-round sequence through the
+    incremental evaluator, comparing its decision JSON byte-for-byte
+    against the pure kernel over the dumped state at EVERY step. Returns
+    {"checks": n, "mismatches": m, "counters": {...}}."""
+    opts = dict(OPTS, min_replicas=world)
+    iq = IncrementalQuorum(opts)
+    now = 1_000_000
+    checks = 0
+    mismatches = 0
+
+    def check(t: int) -> None:
+        nonlocal checks, mismatches
+        checks += 1
+        if iq.decision(t) != quorum_compute_raw(t, iq.state(), opts):
+            mismatches += 1
+
+    # formation: joins arrive one by one
+    for i in range(world):
+        now += 1
+        iq.heartbeat(f"grp_{i:04d}", now)
+        iq.join(now, _member(i))
+        check(now)
+    assert iq.install(now)["installed"], "formation round did not form"
+    check(now)
+    # steady heartbeats: no membership changes -> all cache hits
+    for tick in range(50):
+        now += 100
+        for i in range(world):
+            iq.heartbeat(f"grp_{i:04d}", now)
+        check(now)
+    # second round: fast quorum once every prev member rejoins
+    for i in range(world):
+        now += 1
+        iq.heartbeat(f"grp_{i:04d}", now)
+        iq.join(now, _member(i, step=1))
+        check(now)
+    assert iq.install(now)["installed"], "fast round did not form"
+    # churn: one group dies (heartbeat expiry) + prune, then reform
+    now += OPTS["heartbeat_timeout_ms"] + 1
+    for i in range(world - 1):
+        iq.heartbeat(f"grp_{i:04d}", now)
+    check(now)
+    for i in range(world - 1):
+        now += 1
+        iq.join(now, _member(i, step=2))
+        check(now)
+    return {"checks": checks, "mismatches": mismatches,
+            "counters": iq.counters()}
+
+
+def _normalize_response(resp: Dict[str, Any]) -> str:
+    """Server quorum response minus wall-clock created_ms (the only field
+    that legitimately differs between interleaved arms)."""
+    q = dict(resp["quorum"])
+    q.pop("created_ms", None)
+    return json.dumps(q, sort_keys=True)
+
+
+def run_point(world: int, cache_quorum: bool, batch: int = 32,
+              hb_ticks: int = 10, quorum_timeout: float = 120.0
+              ) -> Dict[str, Any]:
+    """One world-size point against one lighthouse arm. Returns the
+    measured row (counters are deltas between phases)."""
+    lh = Lighthouse(
+        min_replicas=world,
+        join_timeout_ms=OPTS["join_timeout_ms"],
+        quorum_tick_ms=100,
+        heartbeat_timeout_ms=OPTS["heartbeat_timeout_ms"],
+        cache_quorum=cache_quorum,
+    )
+    addr = lh.address()
+    row: Dict[str, Any] = {
+        "world": world,
+        "arm": "cached" if cache_quorum else "recompute",
+    }
+    try:
+        responses: List[Any] = [None] * world
+        barrier = threading.Barrier(world + 1)
+
+        def _requester(i: int, step: int, out: List[Any],
+                       bar: "threading.Barrier") -> None:
+            client = LighthouseClient(addr)
+            bar.wait()
+            out[i] = client.quorum(_member(i, step=step),
+                                   timeout=quorum_timeout)
+
+        # ---- phase 1: formation round (all groups join at once) ----
+        threads = [
+            threading.Thread(target=_requester,
+                             args=(i, 0, responses, barrier), daemon=True)
+            for i in range(world)
+        ]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join(timeout=quorum_timeout)
+        row["quorum_ms"] = (time.perf_counter() - t0) * 1e3
+        if any(r is None for r in responses):
+            raise RuntimeError(
+                f"formation incomplete at world={world}: "
+                f"{sum(r is None for r in responses)} groups unanswered"
+            )
+        norm = {_normalize_response(r) for r in responses}
+        row["responses_identical"] = len(norm) == 1
+        row["response_norm"] = norm.pop() if len(norm) == 1 else None
+        row["response_bytes"] = len(
+            json.dumps(responses[0], separators=(",", ":"))
+        )
+        c_form = _control(addr)
+        row["form"] = {
+            "quorum_compute_count": c_form["quorum_compute_count"],
+            "quorum_cache_hits": c_form["quorum_cache_hits"],
+            "quorum_rpcs": c_form["quorum_rpcs"],
+            "membership_epoch": c_form["membership_epoch"],
+        }
+
+        # ---- phase 2: steady heartbeat window, piggyback parked half --
+        # Park half the fleet on the NEXT round's long-poll: these
+        # groups post NO heartbeats at all for longer than the heartbeat
+        # timeout — only the server-side waiter re-stamp (the piggyback
+        # liveness mechanism) can keep them healthy. The unparked rest
+        # are covered by per-domain batch RPCs on a real-time cadence.
+        parked = world // 2
+        responses2: List[Any] = [None] * world
+        barrier2 = threading.Barrier(parked + 1)
+        park_threads = [
+            threading.Thread(target=_requester,
+                             args=(i, 1, responses2, barrier2), daemon=True)
+            for i in range(parked)
+        ]
+        for t in park_threads:
+            t.start()
+        barrier2.wait()
+        time.sleep(0.2)  # let the parked joins land server-side
+        c1 = _control(addr)
+
+        # batched arm: ceil((world-parked)/batch) RPCs per tick, ticks
+        # paced so the total window exceeds the heartbeat timeout
+        hb_timeout_s = OPTS["heartbeat_timeout_ms"] / 1e3
+        tick_s = 1.25 * hb_timeout_s / hb_ticks
+        client = LighthouseClient(addr)
+        rest = [f"grp_{i:04d}" for i in range(parked, world)]
+        for _ in range(hb_ticks):
+            for lo in range(0, len(rest), batch):
+                client.heartbeat(rest[lo:lo + batch])
+            time.sleep(tick_s)
+        c2 = _control(addr)
+        # SHARP liveness oracle: the parked half has now gone
+        # ~1.25x heartbeat_timeout with zero heartbeat RPCs — healthy
+        # requires the long-poll re-stamp to be working
+        healthy = c2["healthy_replicas"]
+
+        # per-replica arm: every group posts its own heartbeat per tick
+        # (the pre-PR-10 manager path: no piggyback, no batching); RPC
+        # counting only, so no real-time pacing needed
+        for _ in range(hb_ticks):
+            for i in range(world):
+                client.heartbeat(f"grp_{i:04d}")
+        c3 = _control(addr)
+
+        # evaluation-triggering RPCs with ZERO membership change: status
+        # polls (dashboard / fleet_top load). The cached arm must stay
+        # flat here — this is the "recompute count is O(membership
+        # changes), not O(RPCs)" counter claim in its purest form.
+        status_polls = 50
+        for _ in range(status_polls):
+            _control(addr)
+        c4 = _control(addr)
+
+        row["steady"] = {
+            "hb_ticks": hb_ticks,
+            "parked": parked,
+            "batch": batch,
+            "batched_rpcs_per_tick":
+                (c2["heartbeat_rpcs"] - c1["heartbeat_rpcs"]) / hb_ticks,
+            "per_replica_rpcs_per_tick":
+                (c3["heartbeat_rpcs"] - c2["heartbeat_rpcs"]) / hb_ticks,
+            "batched_compute_delta":
+                c2["quorum_compute_count"] - c1["quorum_compute_count"],
+            "per_replica_compute_delta":
+                c3["quorum_compute_count"] - c2["quorum_compute_count"],
+            "cache_hits_delta":
+                c3["quorum_cache_hits"] - c1["quorum_cache_hits"],
+            "status_polls": status_polls,
+            "status_poll_compute_delta":
+                c4["quorum_compute_count"] - c3["quorum_compute_count"],
+            "status_poll_hits_delta":
+                c4["quorum_cache_hits"] - c3["quorum_cache_hits"],
+            "all_healthy": healthy == world,
+            "healthy": healthy,
+        }
+
+        # ---- phase 3: release the parked round (fast quorum) ----
+        barrier3 = threading.Barrier(world - parked + 1)
+        rel_threads = [
+            threading.Thread(target=_requester,
+                             args=(i, 1, responses2, barrier3), daemon=True)
+            for i in range(parked, world)
+        ]
+        for t in rel_threads:
+            t.start()
+        barrier3.wait()
+        t1 = time.perf_counter()
+        for t in park_threads + rel_threads:
+            t.join(timeout=quorum_timeout)
+        row["quorum2_ms"] = (time.perf_counter() - t1) * 1e3
+        row["round2_complete"] = all(r is not None for r in responses2)
+        c_end = _control(addr)
+        row["total"] = {k: c_end[k] for k in (
+            "quorum_compute_count", "quorum_cache_hits", "quorum_rpcs",
+            "heartbeat_rpcs", "heartbeat_ids", "membership_epoch",
+            "cache_enabled",
+        )}
+        with urllib.request.urlopen(addr + "/statsz", timeout=10) as r:
+            row["http_conns_accepted"] = json.load(r)["http_conns_accepted"]
+    finally:
+        lh.shutdown()
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--worlds", default="16,32,64,128,256",
+                    help="comma-separated world sizes (groups)")
+    ap.add_argument("--reps", type=int, default=2,
+                    help="interleaved A/B repetitions per world size")
+    ap.add_argument("--batch", type=int, default=32,
+                    help="heartbeat batch size (domain width)")
+    ap.add_argument("--hb-ticks", type=int, default=10,
+                    help="logical heartbeat intervals per steady window")
+    ap.add_argument("--out", default=None, help="write JSON artifact here")
+    ap.add_argument("--skip-oracle", action="store_true",
+                    help="skip the in-process decision-equality replay")
+    args = ap.parse_args()
+
+    worlds = [int(w) for w in args.worlds.split(",") if w]
+    payload: Dict[str, Any] = {
+        "metric": "bench_fleet",
+        "worlds": worlds,
+        "reps": args.reps,
+        "batch": args.batch,
+        "hb_ticks": args.hb_ticks,
+        "rows": [],
+        "oracle": {},
+    }
+    failures: List[str] = []
+
+    for world in worlds:
+        if not args.skip_oracle:
+            t0 = time.perf_counter()
+            orc = oracle_replay(world)
+            orc["replay_ms"] = (time.perf_counter() - t0) * 1e3
+            payload["oracle"][str(world)] = orc
+            if orc["mismatches"]:
+                failures.append(
+                    f"world={world}: {orc['mismatches']}/{orc['checks']} "
+                    "incremental-vs-kernel decision mismatches"
+                )
+            print(f"[oracle] world={world} checks={orc['checks']} "
+                  f"mismatches={orc['mismatches']} "
+                  f"computes={orc['counters']['compute_count']} "
+                  f"hits={orc['counters']['cache_hits']}", flush=True)
+        for rep in range(args.reps):
+            # rep-interleaved: cached then recompute within each rep
+            for cache in (True, False):
+                row = run_point(world, cache, batch=args.batch,
+                                hb_ticks=args.hb_ticks)
+                row["rep"] = rep
+                payload["rows"].append(row)
+                if not row["responses_identical"]:
+                    failures.append(
+                        f"world={world} arm={row['arm']} rep={rep}: "
+                        "divergent quorum responses across groups"
+                    )
+                if not row["steady"]["all_healthy"]:
+                    failures.append(
+                        f"world={world} arm={row['arm']} rep={rep}: "
+                        f"liveness oracle failed "
+                        f"({row['steady']['healthy']}/{world} healthy)"
+                    )
+                st = row["steady"]
+                print(
+                    f"[world={world:4d} {row['arm']:9s} rep={rep}] "
+                    f"quorum={row['quorum_ms']:8.1f}ms "
+                    f"fast={row['quorum2_ms']:7.1f}ms "
+                    f"computes={row['total']['quorum_compute_count']:6d} "
+                    f"hits={row['total']['quorum_cache_hits']:6d} "
+                    f"poll_computes={st['status_poll_compute_delta']:3d} "
+                    f"hb/tick {st['per_replica_rpcs_per_tick']:.0f}->"
+                    f"{st['batched_rpcs_per_tick']:.0f}",
+                    flush=True,
+                )
+            # cross-arm response equality (normalized): the cached and
+            # recompute planes must announce the same quorum
+            cached_rows = [r for r in payload["rows"]
+                           if r["world"] == world and r["rep"] == rep]
+            norms = {r["response_norm"] for r in cached_rows}
+            if len(norms) != 1 or None in norms:
+                failures.append(
+                    f"world={world} rep={rep}: cached vs recompute "
+                    "announced different quorums"
+                )
+
+    payload["failures"] = failures
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1)
+        print(f"wrote {args.out}")
+    print(json.dumps({k: payload[k] for k in
+                      ("metric", "worlds", "failures")}))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -392,6 +392,66 @@ def sharded_smoke() -> "list[str]":
     return failures
 
 
+def fleet_smoke() -> "list[str]":
+    """One in-process 32-group control-plane sweep point (the ISSUE 10
+    gate): real HTTP against a live cached-quorum lighthouse plus the
+    incremental-vs-kernel decision replay. Fails on missing/non-finite
+    quorum_ms, a missing recompute counter surface, a liveness-oracle
+    miss, or ANY cached-vs-recompute decision mismatch."""
+    import math
+
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    import bench_fleet
+
+    failures: "list[str]" = []
+    try:
+        orc = bench_fleet.oracle_replay(32)
+        if orc["mismatches"]:
+            failures.append(
+                f"fleet smoke: {orc['mismatches']}/{orc['checks']} "
+                "incremental-vs-kernel decision mismatches"
+            )
+        if orc["counters"].get("cache_hits", 0) <= 0:
+            failures.append(
+                "fleet smoke: incremental plane recorded zero cache hits "
+                "over a steady-heartbeat replay — epoch cache regressed"
+            )
+        row = bench_fleet.run_point(32, cache_quorum=True, hb_ticks=3)
+    except Exception as e:  # noqa: BLE001
+        return [f"fleet smoke: sweep point failed: {e!r}"]
+    for key in ("quorum_ms", "quorum2_ms"):
+        v = row.get(key)
+        if v is None or not math.isfinite(float(v)) or float(v) <= 0:
+            failures.append(
+                f"fleet smoke: {key!r} missing/non-finite: {v!r}"
+            )
+    total = row.get("total") or {}
+    for key in ("quorum_compute_count", "quorum_cache_hits",
+                "heartbeat_rpcs", "membership_epoch"):
+        if not isinstance(total.get(key), int):
+            failures.append(
+                f"fleet smoke: control counter {key!r} missing: "
+                f"{total.get(key)!r}"
+            )
+    if not row.get("responses_identical"):
+        failures.append(
+            "fleet smoke: quorum responses diverged across groups"
+        )
+    st = row.get("steady") or {}
+    if not st.get("all_healthy"):
+        failures.append(
+            "fleet smoke: liveness oracle failed — parked/batched groups "
+            f"went unhealthy ({st.get('healthy')}/32)"
+        )
+    if st.get("status_poll_compute_delta", 1) != 0:
+        failures.append(
+            "fleet smoke: cached plane recomputed on membership-stable "
+            f"status polls ({st.get('status_poll_compute_delta')} times) "
+            "— the epoch cache is not serving"
+        )
+    return failures
+
+
 def main() -> int:
     env = {
         k: v for k, v in os.environ.items()
@@ -437,6 +497,7 @@ def main() -> int:
     failures += xla_smoke()
     failures += events_smoke()
     failures += sharded_smoke()
+    failures += fleet_smoke()
     for key in ("t1_pipeline_overlap", "t1_pipeline_ms", "t1_ddp_streamed",
                 "t1_overhead_ms", "t1_outer_overlap", "t1_outer_wire_ms",
                 "comm_backend", "t1_events_recorded",
@@ -493,7 +554,7 @@ def main() -> int:
         f"events_recorded={payload.get('t1_events_recorded')} "
         f"opt_state_ratio={(payload.get('sharded') or {}).get('state_bytes_ratio')} "
         "heal_gauges=ok outer_gauges=ok xla_gauges=ok chrome_trace=ok "
-        "sharded_gauges=ok"
+        "sharded_gauges=ok fleet_gauges=ok"
     )
     return 0
 

@@ -50,19 +50,52 @@ def discover_managers(
     None with ``error`` set when a group's store was unreachable).
     Store walks fan out per replica group: a DEAD group's store blocks
     its connect retry for the full ``timeout``, and paying that serially
-    would stall the whole screen during an incident."""
+    would stall the whole screen during an incident.
+
+    Two-level trees (PR 10): when the root's ``/status.json`` carries a
+    ``domains`` table (tier-1 aggregator lighthouses reporting upstream),
+    each aggregator's own ``/status.json`` is walked too and its quorum
+    participants join the discovery set tagged with their domain name —
+    one command still covers the whole fleet."""
     from concurrent.futures import ThreadPoolExecutor
 
     from torchft_tpu.comm.store import StoreClient
 
     status = fetch_json(lighthouse.rstrip("/") + "/status.json", timeout)
-    members = status.get("quorum", {}).get("participants", [])
+    members = list(status.get("quorum", {}).get("participants", []))
+    domains = sorted(
+        (name, dom["address"])
+        for name, dom in (status.get("domains") or {}).items()
+        if dom.get("address")
+    )
+    if domains:
+        # Fan the per-aggregator walks out for the same reason as the
+        # store walks below: several partitioned aggregators must cost
+        # ONE timeout, not a serial stall of the whole screen.
+        def _walk_domain(item):
+            name, addr = item
+            try:
+                return name, fetch_json(
+                    addr.rstrip("/") + "/status.json", timeout
+                ), None
+            except Exception as e:  # noqa: BLE001 — a dead aggregator
+                # is fleet weather; its staleness flag tells the story
+                return name, None, repr(e)[:120]
+
+        with ThreadPoolExecutor(max_workers=min(8, len(domains))) as pool:
+            for name, dstatus, err in pool.map(_walk_domain, domains):
+                if err is not None:
+                    status.setdefault("domain_errors", {})[name] = err
+                    continue
+                for m in dstatus.get("quorum", {}).get("participants", []):
+                    members.append(dict(m, domain=name))
 
     def _walk(member: Dict[str, Any]) -> List[Dict[str, Any]]:
         base = {
             "replica_id": member.get("replica_id", "?"),
             "step": member.get("step"),
             "manager_addr": member.get("address", ""),
+            "domain": member.get("domain"),
         }
         world = int(member.get("world_size", 1) or 1)
         try:
@@ -122,8 +155,11 @@ def build_row(ep: Dict[str, Any],
     event for this endpoint, shown with a growing age when the
     INCREMENTAL poll returns nothing new — a wedged replica emitting no
     events is exactly when the last-event column matters."""
+    replica = str(ep.get("replica_id", "?"))[:24]
+    if ep.get("domain"):
+        replica = f"{ep['domain']}/{replica}"[:32]
     row = {
-        "replica": str(ep.get("replica_id", "?"))[:24],
+        "replica": replica,
         "rank": ep.get("rank", 0),
         "step": ep.get("step"),
         "epoch": None,
@@ -171,6 +207,39 @@ _COLUMNS = (
 )
 
 
+def render_tree(status: Dict[str, Any]) -> List[str]:
+    """Tier tree lines from the root's /status.json: one line per
+    reporting domain aggregator, flagging the ones whose upstream report
+    is stale (the aggregator died or lost its route to the root)."""
+    out: List[str] = []
+    ctl = status.get("control") or {}
+    domains = status.get("domains") or {}
+    if not domains and not ctl.get("tier"):
+        return out
+    out.append(
+        f"tier{ctl.get('tier', 0)} root · "
+        f"quorum_compute={ctl.get('quorum_compute_count', '-')} "
+        f"cache_hits={ctl.get('quorum_cache_hits', '-')} "
+        f"hb_rpcs={ctl.get('heartbeat_rpcs', '-')}"
+    )
+    errors = status.get("domain_errors") or {}
+    for name, dom in sorted(domains.items()):
+        stale = dom.get("stale")
+        flag = "  ** STALE REPORT **" if stale else ""
+        if name in errors:
+            flag += f"  [unreachable: {errors[name]}]"
+        out.append(
+            f"  └ {name} (tier{dom.get('tier', 1)}) "
+            f"{dom.get('address', '?')} · "
+            f"{dom.get('healthy', '?')} healthy · "
+            f"qid {dom.get('quorum_id', '?')} · "
+            f"max step {dom.get('max_step', '?')} · "
+            f"report {_fmt((dom.get('report_age_ms') or 0) / 1000.0)}s ago"
+            f"{flag}"
+        )
+    return out
+
+
 def render(status: Dict[str, Any], rows: List[Dict[str, Any]]) -> str:
     out = []
     q = status.get("quorum", {})
@@ -181,6 +250,7 @@ def render(status: Dict[str, Any], rows: List[Dict[str, Any]]) -> str:
         f"age {_fmt((status.get('quorum_age_ms') or 0) / 1000.0)}s"
     )
     out.append(f"  {status.get('reason', '')}")
+    out.extend(render_tree(status))
     hdr = " ".join(name.ljust(w) for name, w in _COLUMNS)
     out.append(hdr)
     out.append("-" * len(hdr))

@@ -450,6 +450,185 @@ def test_repeated_quorums_stable_id(lighthouse) -> None:
         mgr.shutdown()
 
 
+def _status_json(addr):
+    import json
+
+    return json.load(
+        urllib.request.urlopen(addr + "/status.json", timeout=5)
+    )
+
+
+def test_batched_heartbeat_and_counters(lighthouse) -> None:
+    # One RPC carrying a whole domain's replica_ids (the tier-1
+    # aggregator wire form) registers every id, and the control counters
+    # pin the RPC-vs-ids accounting the fleet bench reads.
+    from torchft_tpu.control import LighthouseClient
+
+    addr = lighthouse.address()
+    client = LighthouseClient(addr)
+    client.heartbeat(["batch_a", "batch_b", "batch_c"])
+    client.heartbeat("single")
+    status = _status_json(addr)
+    for rid in ("batch_a", "batch_b", "batch_c", "single"):
+        assert status["heartbeats"][rid]["dead"] is False
+    ctl = status["control"]
+    assert ctl["heartbeat_rpcs"] == 2
+    assert ctl["heartbeat_ids"] == 4
+    assert ctl["cache_enabled"] is True
+    assert ctl["tier"] == 0 and ctl["upstream"] == ""
+    for key in ("quorum_compute_count", "quorum_cache_hits",
+                "membership_epoch", "quorum_rpcs", "heartbeats_pruned",
+                "participants_pruned", "healthy_replicas"):
+        assert isinstance(ctl[key], int), key
+
+
+def test_status_polls_hit_decision_cache(lighthouse) -> None:
+    # Membership-stable status polls must be served from the epoch cache
+    # (recompute count is O(membership changes), not O(RPCs)); with
+    # cache_quorum=False the same polls recompute every time.
+    addr = lighthouse.address()
+    lighthouse_heartbeat(addr, "pollster")
+    base = _status_json(addr)["control"]
+    for _ in range(20):
+        _status_json(addr)
+    ctl = _status_json(addr)["control"]
+    assert ctl["quorum_compute_count"] == base["quorum_compute_count"]
+    assert ctl["quorum_cache_hits"] >= base["quorum_cache_hits"] + 20
+
+    lh2 = Lighthouse(min_replicas=1, join_timeout_ms=100,
+                     cache_quorum=False)
+    try:
+        addr2 = lh2.address()
+        lighthouse_heartbeat(addr2, "pollster")
+        base2 = _status_json(addr2)["control"]
+        assert base2["cache_enabled"] is False
+        for _ in range(20):
+            _status_json(addr2)
+        ctl2 = _status_json(addr2)["control"]
+        assert ctl2["quorum_compute_count"] >= (
+            base2["quorum_compute_count"] + 20
+        )
+        assert ctl2["quorum_cache_hits"] == 0
+    finally:
+        lh2.shutdown()
+
+
+def test_lighthouse_prunes_departed_heartbeats() -> None:
+    # Nothing used to erase state_.heartbeats; now long-dead entries are
+    # pruned at sweep boundaries with a counter (never silently).
+    import time as _time
+
+    lh = Lighthouse(min_replicas=1, join_timeout_ms=50,
+                    quorum_tick_ms=25, heartbeat_timeout_ms=100,
+                    prune_after_ms=300)
+    try:
+        addr = lh.address()
+        lighthouse_heartbeat(addr, "ephemeral")
+        assert "ephemeral" in _status_json(addr)["heartbeats"]
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            status = _status_json(addr)
+            if "ephemeral" not in status["heartbeats"]:
+                break
+            _time.sleep(0.05)
+        assert "ephemeral" not in status["heartbeats"], status["heartbeats"]
+        assert status["control"]["heartbeats_pruned"] >= 1
+    finally:
+        lh.shutdown()
+
+
+def test_quorum_longpoll_piggybacks_heartbeats() -> None:
+    # A manager with a lighthouse quorum RPC in flight must (a) SKIP its
+    # separate heartbeat RPCs (the piggyback path) and (b) stay healthy
+    # the whole time via the server-side waiter re-stamp — with a
+    # heartbeat timeout far shorter than the park duration, liveness can
+    # only come from the re-stamp. Then a second replica joins and the
+    # parked quorum completes.
+    lh = Lighthouse(min_replicas=2, join_timeout_ms=60000,
+                    quorum_tick_ms=50, heartbeat_timeout_ms=600)
+    mgr_a = mgr_b = None
+    try:
+        mgr_a = _make_manager(lh, "park_a", heartbeat_interval=0.05)
+        client_a = ManagerClient(mgr_a.address())
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            fut_a = pool.submit(
+                client_a.quorum, 0, 1, "meta", False, 30.0
+            )
+            time.sleep(0.3)  # the quorum RPC is now parked lighthouse-side
+            c0 = _status_json(lh.address())["control"]
+            park_window = 1.5  # >> heartbeat_timeout of 0.6s
+            time.sleep(park_window)
+            status = _status_json(lh.address())
+            c1 = status["control"]
+            # (a) piggyback: at 50ms intervals the old path would post
+            # ~30 heartbeats over the window; the in-flight quorum
+            # suppresses (nearly) all of them
+            assert c1["heartbeat_rpcs"] - c0["heartbeat_rpcs"] <= 3, (
+                c0, c1,
+            )
+            # (b) waiter re-stamp: parked for 2.5x the heartbeat timeout
+            # yet still alive
+            assert status["heartbeats"]["park_a"]["dead"] is False
+            # release: second replica joins -> quorum forms for both
+            mgr_b = _make_manager(lh, "park_b", heartbeat_interval=0.05)
+            client_b = ManagerClient(mgr_b.address())
+            fut_b = pool.submit(
+                client_b.quorum, 0, 1, "meta", False, 30.0
+            )
+            res_a = fut_a.result(timeout=30)
+            res_b = fut_b.result(timeout=30)
+            assert res_a.quorum_id == res_b.quorum_id
+            assert res_a.replica_world_size == 2
+    finally:
+        if mgr_a:
+            mgr_a.shutdown()
+        if mgr_b:
+            mgr_b.shutdown()
+        lh.shutdown()
+
+
+def test_dead_longpoll_waiter_is_not_kept_alive() -> None:
+    # The waiter re-stamp must not outlive its client: a requester whose
+    # process dies mid-long-poll (socket closed, no response read) has to
+    # expire after heartbeat_timeout like any dead replica — NOT stay
+    # "healthy" until the RPC deadline because the parked handler keeps
+    # stamping it. The handler peeks the serving socket before each
+    # re-stamp (native/lighthouse.cc handle_quorum).
+    import json as _json
+    import socket
+
+    lh = Lighthouse(min_replicas=2, join_timeout_ms=60000,
+                    quorum_tick_ms=50, heartbeat_timeout_ms=400)
+    try:
+        addr = lh.address()
+        host, port = addr[len("http://"):].rsplit(":", 1)
+        body = _json.dumps({"requester": {
+            "replica_id": "ghost", "address": "a", "store_address": "s",
+            "step": 0, "world_size": 1, "shrink_only": False,
+        }}).encode()
+        sock = socket.create_connection((host, int(port)), timeout=5)
+        sock.sendall(
+            b"POST /torchft.LighthouseService/Quorum HTTP/1.1\r\n"
+            b"Host: x\r\nContent-Type: application/json\r\n"
+            b"x-timeout-ms: 30000\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n"
+            + body
+        )
+        time.sleep(0.3)  # the waiter is parked (min_replicas=2)
+        status = _status_json(addr)
+        assert status["heartbeats"]["ghost"]["dead"] is False
+        sock.close()  # the "process" dies without ever reading a reply
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            status = _status_json(addr)
+            if status["heartbeats"].get("ghost", {}).get("dead"):
+                break
+            time.sleep(0.05)
+        assert status["heartbeats"]["ghost"]["dead"] is True, status
+    finally:
+        lh.shutdown()
+
+
 def test_control_plane_connection_reuse() -> None:
     # Keep-alive parity with ref src/net.rs: a manager heartbeating every
     # 50ms for ~1.5s (~30 RPCs) must NOT open a socket per request — the

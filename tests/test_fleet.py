@@ -1,0 +1,256 @@
+"""Fleet-scale control-plane tests: the two-level lighthouse tree
+(tier-1 domain aggregators reporting one membership summary upstream),
+fleet_top's tree rendering/staleness flags, and the bench_fleet sweep
+machinery (ISSUE 10)."""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from torchft_tpu.control import (
+    Lighthouse,
+    LighthouseClient,
+    lighthouse_quorum,
+)
+
+_SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+)
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+
+
+def _status(addr):
+    with urllib.request.urlopen(addr + "/status.json", timeout=5) as r:
+        return json.load(r)
+
+
+def _member(rid, step=0):
+    return {
+        "replica_id": rid,
+        "address": f"http://{rid}:1",
+        "store_address": f"store_{rid}:1",
+        "step": step,
+        "world_size": 1,
+        "shrink_only": False,
+    }
+
+
+def _wait_for(pred, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not reached within timeout")
+
+
+class TestTwoLevelTree:
+    def test_aggregator_reports_domain_summary_upstream(self) -> None:
+        # A tier-1 aggregator holds its domain's quorum and the root sees
+        # exactly ONE summary per domain — never per-replica state.
+        root = Lighthouse(min_replicas=1)
+        agg = Lighthouse(
+            min_replicas=2,
+            join_timeout_ms=200,
+            domain="rack0",
+            upstream_addr=root.address(),
+            upstream_report_interval_ms=100,
+        )
+        try:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                futs = [
+                    pool.submit(
+                        lighthouse_quorum, agg.address(),
+                        _member(f"grp_{i}", step=3), 10.0
+                    )
+                    for i in range(2)
+                ]
+                for f in futs:
+                    f.result(timeout=15)
+
+            def _domain_ready():
+                doms = _status(root.address()).get("domains") or {}
+                d = doms.get("rack0")
+                return d if d and d["healthy"] >= 2 else None
+
+            dom = _wait_for(_domain_ready)
+            assert dom["tier"] == 1
+            assert dom["address"] == agg.address()
+            assert dom["quorum_id"] >= 1
+            assert dom["max_step"] == 3
+            assert dom["stale"] is False
+            assert dom["report_interval_ms"] == 100
+            # the root's OWN quorum state knows nothing of rack0 replicas
+            root_status = _status(root.address())
+            assert "quorum" not in root_status
+            assert "grp_0" not in root_status["heartbeats"]
+            # the aggregator's own status carries its tier labels
+            agg_ctl = _status(agg.address())["control"]
+            assert agg_ctl["tier"] == 1
+            assert agg_ctl["domain"] == "rack0"
+            assert agg_ctl["upstream"] == root.address()
+        finally:
+            agg.shutdown()
+            root.shutdown()
+
+    def test_root_flags_stale_aggregator(self) -> None:
+        root = Lighthouse(min_replicas=1)
+        agg = Lighthouse(
+            min_replicas=1,
+            domain="rackX",
+            upstream_addr=root.address(),
+            upstream_report_interval_ms=50,
+        )
+        try:
+            _wait_for(
+                lambda: (_status(root.address()).get("domains") or {})
+                .get("rackX")
+            )
+            agg.shutdown()
+            dom = _wait_for(
+                lambda: (
+                    (_status(root.address()).get("domains") or {})
+                    .get("rackX")
+                    if (_status(root.address()).get("domains") or {})
+                    .get("rackX", {}).get("stale")
+                    else None
+                )
+            )
+            assert dom["stale"] is True
+            assert dom["report_age_ms"] > 3 * 50
+            # eviction: the stale row is eventually pruned (well after
+            # the STALE flag, max(20x interval, 3s)) and counted — a
+            # restarting aggregator under generated domain names can't
+            # grow the root's map forever
+            _wait_for(
+                lambda: "rackX" not in (
+                    _status(root.address()).get("domains") or {}
+                ),
+                timeout=12,
+            )
+            assert _status(root.address())["control"]["domains_pruned"] >= 1
+        finally:
+            agg.shutdown()
+            root.shutdown()
+
+    def test_fleet_top_renders_tree_and_stale_flag(self) -> None:
+        # fleet_top discovery walks root -> domains -> aggregator
+        # status.json; render_tree shows the domain rows and flags a
+        # stale aggregator loudly.
+        import fleet_top
+
+        root = Lighthouse(min_replicas=1)
+        agg = Lighthouse(
+            min_replicas=1,
+            join_timeout_ms=100,
+            domain="rackA",
+            upstream_addr=root.address(),
+            upstream_report_interval_ms=50,
+        )
+        try:
+            lighthouse_quorum(agg.address(), _member("grp_live"), 10.0)
+            _wait_for(
+                lambda: (_status(root.address()).get("domains") or {})
+                .get("rackA")
+            )
+            status, endpoints = fleet_top.discover_managers(
+                root.address(), timeout=5.0
+            )
+            # the aggregator's participant joined the discovery set,
+            # tagged with its domain
+            assert any(
+                ep["replica_id"] == "grp_live" and ep.get("domain") == "rackA"
+                for ep in endpoints
+            )
+            tree = "\n".join(fleet_top.render_tree(status))
+            assert "rackA" in tree and "tier1" in tree
+            assert "STALE" not in tree
+            rendered = fleet_top.render(status, [])
+            assert "rackA" in rendered
+
+            agg.shutdown()
+            _wait_for(
+                lambda: (_status(root.address()).get("domains") or {})
+                .get("rackA", {}).get("stale")
+            )
+            status2, _ = fleet_top.discover_managers(
+                root.address(), timeout=5.0
+            )
+            tree2 = "\n".join(fleet_top.render_tree(status2))
+            assert "STALE" in tree2
+            # the dead aggregator's walk failure is surfaced, not silent
+            assert status2.get("domain_errors", {}).get("rackA")
+        finally:
+            agg.shutdown()
+            root.shutdown()
+
+
+class TestBenchFleet:
+    def test_oracle_replay_zero_mismatches(self) -> None:
+        import bench_fleet
+
+        orc = bench_fleet.oracle_replay(24)
+        assert orc["mismatches"] == 0
+        assert orc["checks"] > 24
+        # steady heartbeats replay entirely from cache
+        assert orc["counters"]["cache_hits"] >= 50
+
+    def test_run_point_counters_and_liveness(self) -> None:
+        import bench_fleet
+
+        row = bench_fleet.run_point(12, cache_quorum=True, batch=4,
+                                    hb_ticks=3, quorum_timeout=60.0)
+        assert row["responses_identical"] is True
+        assert row["round2_complete"] is True
+        st = row["steady"]
+        assert st["all_healthy"] is True
+        # per-replica arm posts one RPC per group per tick; the batched
+        # arm covers the unparked half in ceil(6/4)=2 RPCs per tick
+        assert st["per_replica_rpcs_per_tick"] == 12
+        assert st["batched_rpcs_per_tick"] == 2
+        # membership-stable status polls never recompute on the cached arm
+        assert st["status_poll_compute_delta"] == 0
+        assert st["status_poll_hits_delta"] >= st["status_polls"]
+        assert row["total"]["cache_enabled"] is True
+
+    def test_run_point_recompute_arm_pays_per_rpc(self) -> None:
+        import bench_fleet
+
+        row = bench_fleet.run_point(8, cache_quorum=False, batch=4,
+                                    hb_ticks=2, quorum_timeout=60.0)
+        assert row["total"]["cache_enabled"] is False
+        assert row["total"]["quorum_cache_hits"] == 0
+        # every status poll recomputes on the always-recompute arm
+        assert row["steady"]["status_poll_compute_delta"] >= (
+            row["steady"]["status_polls"]
+        )
+
+
+@pytest.mark.parametrize("batch", [1, 3])
+def test_batched_heartbeat_equivalence(batch) -> None:
+    # Batched and single-id heartbeats register identical healthy sets.
+    lh = Lighthouse(min_replicas=1)
+    try:
+        client = LighthouseClient(lh.address())
+        ids = [f"eq_{i}" for i in range(6)]
+        for lo in range(0, len(ids), batch):
+            chunk = ids[lo:lo + batch]
+            if len(chunk) == 1:
+                client.heartbeat(chunk[0])
+            else:
+                client.heartbeat(chunk)
+        status = _status(lh.address())
+        assert all(
+            status["heartbeats"][rid]["dead"] is False for rid in ids
+        )
+        assert status["control"]["heartbeat_ids"] == len(ids)
+    finally:
+        lh.shutdown()

@@ -346,6 +346,226 @@ def test_observers_invisible_to_step_and_recovery_logic() -> None:
     assert res_obs["transport_rank"] is None
 
 
+# --------------------------------------------------------------- fleet scale
+# ISSUE 10: scale/property coverage for the decision kernel and the
+# incremental cached plane the lighthouse serves at O(100-1000) groups.
+
+
+def quorum_compute_raw_state(now_ms, participants, heartbeats, prev_quorum,
+                             opts):
+    """Like quorum_compute but returns the RAW decision JSON string (the
+    byte-identity currency)."""
+    from torchft_tpu.control import quorum_compute_raw
+
+    state = {
+        "participants": [
+            {"joined_ms": j, "member": m} for j, m in participants
+        ],
+        "heartbeats": heartbeats,
+        "prev_quorum": prev_quorum,
+    }
+    return quorum_compute_raw(now_ms, json.dumps(state), opts)
+
+
+def test_scale_decision_arrival_order_independent() -> None:
+    # n>=100 groups: the decision must be deterministic and independent
+    # of the order participants appear in the request state — the wire
+    # arrival order at a real lighthouse is racy by nature.
+    import random
+
+    n = 120
+    members = [
+        member(f"grp_{i:04d}", step=i % 3, world_size=1 + i % 2)
+        for i in range(n)
+    ]
+    participants = [(100 + i, m) for i, m in enumerate(members)]
+    heartbeats = {m["replica_id"]: 900 for m in members}
+    baseline = quorum_compute_raw_state(
+        1000, participants, heartbeats, None, OPTS
+    )
+    q, reason = quorum_compute(1000, participants, heartbeats, None, OPTS)
+    assert q is not None and len(q) == n
+    assert [m["replica_id"] for m in q] == sorted(
+        m["replica_id"] for m in members
+    )
+    for seed in range(3):
+        shuffled = list(participants)
+        random.Random(seed).shuffle(shuffled)
+        hb_items = list(heartbeats.items())
+        random.Random(seed + 99).shuffle(hb_items)
+        assert quorum_compute_raw_state(
+            1000, shuffled, dict(hb_items), None, OPTS
+        ) == baseline
+
+
+def test_scale_prev_quorum_tie_break_stable_under_churn() -> None:
+    # With a prev quorum installed, repeated evaluations under churn
+    # (members dying/rejoining in different arrival orders) must keep the
+    # candidate ordering and fast/slow classification stable.
+    import random
+
+    n = 100
+    members = [member(f"grp_{i:04d}") for i in range(n)]
+    prev = {
+        "quorum_id": 7,
+        "participants": members,
+        "created_ms": 0,
+    }
+    # all prev members back -> fast quorum, sorted ids, any arrival order
+    participants = [(500, m) for m in members]
+    heartbeats = {m["replica_id"]: 900 for m in members}
+    ref = quorum_compute_raw_state(1000, participants, heartbeats, prev, OPTS)
+    assert "Fast quorum" in json.loads(ref)["reason"]
+    for seed in range(3):
+        shuffled = list(participants)
+        random.Random(seed).shuffle(shuffled)
+        assert quorum_compute_raw_state(
+            1000, shuffled, heartbeats, prev, OPTS
+        ) == ref
+    # kill one member: no longer fast; the survivor candidate list stays
+    # the sorted survivor set regardless of arrival order
+    dead = members[37]["replica_id"]
+    alive = [(500, m) for m in members if m["replica_id"] != dead]
+    hb_alive = {k: v for k, v in heartbeats.items() if k != dead}
+    q, reason = quorum_compute(1000, alive, hb_alive, prev, OPTS)
+    assert "Fast quorum" not in reason
+    assert q is not None
+    assert [m["replica_id"] for m in q] == sorted(hb_alive)
+    for seed in range(3):
+        shuffled = list(alive)
+        random.Random(seed).shuffle(shuffled)
+        q2, _ = quorum_compute(1000, shuffled, hb_alive, prev, OPTS)
+        assert q2 == q
+
+
+def _iq_random_sequence(seed: int, n_replicas: int, ops: int,
+                        incremental: bool = True):
+    """Drive the native IncrementalQuorum through a random monotonic
+    heartbeat/join/expiry/install sequence, checking at every step that
+    its decision JSON is byte-identical to a from-scratch kernel
+    recompute over the dumped state. Returns (iq, mismatches, checks)."""
+    import random
+
+    from torchft_tpu.control import IncrementalQuorum, quorum_compute_raw
+
+    rng = random.Random(seed)
+    opts = {
+        "min_replicas": rng.choice([1, 2, n_replicas // 2]),
+        "join_timeout_ms": rng.choice([50, 60000]),
+        "heartbeat_timeout_ms": 5000,
+    }
+    iq = IncrementalQuorum(opts, incremental=incremental)
+    now = 1_000_000
+    checks = mismatches = 0
+    ids = [f"r_{i:03d}" for i in range(n_replicas)]
+    for _ in range(ops):
+        now += rng.choice([0, 1, 7, 100])
+        op = rng.random()
+        rid = rng.choice(ids)
+        if op < 0.35:
+            iq.heartbeat(rid, now)
+        elif op < 0.75:
+            iq.heartbeat(rid, now)
+            iq.join(now, member(rid, step=rng.randrange(3),
+                                shrink_only=rng.random() < 0.05))
+        elif op < 0.85:
+            # time jump: some heartbeats expire (and may be pruned)
+            now += rng.choice([5001, 10000, 70000])
+        else:
+            iq.install(now, wall_ms=now)
+        checks += 1
+        if iq.decision(now) != quorum_compute_raw(now, iq.state(), opts):
+            mismatches += 1
+    return iq, mismatches, checks
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_incremental_decision_byte_identical_to_kernel(seed) -> None:
+    # The core PR-10 oracle: after ARBITRARY heartbeat/join/expiry/install
+    # sequences, the incremental cached plane's decision JSON is
+    # byte-identical to a from-scratch recompute — including the reason
+    # strings and candidate ordering.
+    _, mismatches, checks = _iq_random_sequence(
+        seed, n_replicas=30, ops=300
+    )
+    assert checks == 300
+    assert mismatches == 0
+
+
+def test_incremental_decision_byte_identical_at_scale() -> None:
+    # Same property at n>=100 with a join-heavy mix (the formation-storm
+    # shape a real lighthouse sees).
+    _, mismatches, checks = _iq_random_sequence(
+        17, n_replicas=120, ops=400
+    )
+    assert checks == 400
+    assert mismatches == 0
+
+
+def test_incremental_cache_serves_stable_state() -> None:
+    # Counter contract: with no membership change, repeated decisions are
+    # cache hits — recompute count is O(membership changes), not O(calls).
+    from torchft_tpu.control import IncrementalQuorum
+
+    opts = {"min_replicas": 2, "join_timeout_ms": 60000,
+            "heartbeat_timeout_ms": 5000}
+    iq = IncrementalQuorum(opts)
+    now = 1000
+    for i in range(8):
+        iq.heartbeat(f"r{i}", now)
+        iq.join(now, member(f"r{i}"))
+    before = iq.counters()
+    for k in range(100):
+        iq.decision(now + k)  # within the heartbeat window
+    after = iq.counters()
+    assert after["epoch"] == before["epoch"]
+    # at most one recompute to fill the cache; the other 99+ are hits
+    assert after["compute_count"] - before["compute_count"] <= 1
+    assert after["cache_hits"] - before["cache_hits"] >= 99
+    # a membership edge invalidates exactly once
+    iq.heartbeat("r_new", now + 100)
+    iq.decision(now + 100)
+    iq.decision(now + 100)
+    end = iq.counters()
+    assert end["compute_count"] - after["compute_count"] == 1
+
+
+def test_incremental_prunes_departed_replicas() -> None:
+    # Satellite: heartbeats/participants of long-dead replicas are erased
+    # at sweep time with counters — the state no longer grows
+    # monotonically across churn.
+    from torchft_tpu.control import IncrementalQuorum
+
+    opts = {"min_replicas": 1, "join_timeout_ms": 50,
+            "heartbeat_timeout_ms": 100}
+    iq = IncrementalQuorum(opts, prune_after_ms=300)
+    now = 1000
+    for i in range(5):
+        iq.heartbeat(f"dead{i}", now)
+        iq.join(now, member(f"dead{i}"))
+    iq.heartbeat("alive", now)
+    iq.join(now, member("alive"))
+    # advance past prune_after for the dead cohort, keeping one alive
+    for t in range(now + 80, now + 500, 80):
+        iq.heartbeat("alive", t)
+        iq.decision(t)
+    iq.decision(now + 600)
+    state = json.loads(iq.state())
+    assert set(state["heartbeats"]) == {"alive"}
+    assert [p["member"]["replica_id"] for p in state["participants"]] == [
+        "alive"
+    ]
+    counters = iq.counters()
+    assert counters["pruned_heartbeats"] == 5
+    assert counters["pruned_participants"] == 5
+    # the survivor still forms a quorum after the prune (fresh stamp:
+    # the final wait above aged its last heartbeat past the timeout)
+    iq.heartbeat("alive", now + 600)
+    decision = json.loads(iq.decision(now + 600))
+    assert decision["quorum"] is not None
+    assert [m["replica_id"] for m in decision["quorum"]] == ["alive"]
+
+
 def test_all_observer_fallback_emits_coherent_transport() -> None:
     # Degenerate quorum where EVERY member is an observer: the kernel
     # falls back to treating the full membership as data-plane so it

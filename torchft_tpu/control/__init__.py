@@ -17,12 +17,15 @@ from typing import List, Optional
 from torchft_tpu.control._native import check_error, get_lib, take_string
 
 __all__ = [
+    "IncrementalQuorum",
     "Lighthouse",
+    "LighthouseClient",
     "ManagerServer",
     "ManagerClient",
     "QuorumResult",
     "lighthouse_heartbeat",
     "lighthouse_quorum",
+    "quorum_compute_raw",
 ]
 
 
@@ -97,6 +100,20 @@ class Lighthouse:
 
     Note the embedded default join_timeout_ms=100 matches the reference
     pyclass default (lib.rs:285); the CLI default is 60000.
+
+    Fleet-scale options (PR 10):
+
+    - ``cache_quorum``: serve epoch-cached quorum decisions (default).
+      ``False`` runs the pure decision kernel on every evaluation — the
+      always-recompute arm of ``scripts/bench_fleet.py``'s A/B.
+    - ``prune_after_ms``: heartbeat/participant entries dead longer than
+      this are pruned (default 12x heartbeat_timeout_ms).
+    - ``upstream_addr``/``domain``/``tier``: constructing with an
+      upstream address makes this lighthouse a tier-1 aggregator for a
+      domain (rack/ICI) of replica groups — it holds that domain's
+      quorum and posts one membership summary upstream to the root every
+      ``upstream_report_interval_ms``; the root renders the summaries
+      under ``/status.json`` ``domains`` with report staleness.
     """
 
     def __init__(
@@ -107,10 +124,29 @@ class Lighthouse:
         quorum_tick_ms: Optional[int] = None,
         heartbeat_timeout_ms: Optional[int] = None,
         hostname: str = "127.0.0.1",
+        cache_quorum: bool = True,
+        prune_after_ms: Optional[int] = None,
+        tier: Optional[int] = None,
+        domain: Optional[str] = None,
+        upstream_addr: Optional[str] = None,
+        upstream_report_interval_ms: Optional[int] = None,
     ) -> None:
         host, port = _split_bind(bind)
         lib = get_lib()
         err = ctypes.c_char_p()
+        extra = {"cache_quorum": bool(cache_quorum)}
+        if prune_after_ms is not None:
+            extra["prune_after_ms"] = int(prune_after_ms)
+        if tier is not None:
+            extra["tier"] = int(tier)
+        if domain is not None:
+            extra["domain"] = domain
+        if upstream_addr is not None:
+            extra["upstream_addr"] = upstream_addr
+        if upstream_report_interval_ms is not None:
+            extra["upstream_report_interval_ms"] = int(
+                upstream_report_interval_ms
+            )
         self._handle = lib.ft_lighthouse_new(
             host.encode(),
             port,
@@ -119,6 +155,7 @@ class Lighthouse:
             join_timeout_ms if join_timeout_ms is not None else 100,
             quorum_tick_ms if quorum_tick_ms is not None else 100,
             heartbeat_timeout_ms if heartbeat_timeout_ms is not None else 5000,
+            json.dumps(extra).encode(),
             ctypes.byref(err),
         )
         check_error(err)
@@ -289,9 +326,165 @@ class ManagerClient:
                 pass  # interpreter teardown
 
 
+class LighthouseClient:
+    """Persistent client to a lighthouse: heartbeat (single or batched)
+    and quorum RPCs over pooled keep-alive connections. At fleet scale
+    this is the client the tier-1 aggregator / bench harness holds per
+    lighthouse instead of paying a connect per heartbeat; the module-level
+    ``lighthouse_heartbeat``/``lighthouse_quorum`` one-shots remain as
+    thin wrappers for compatibility."""
+
+    def __init__(self, addr: str) -> None:
+        lib = get_lib()
+        err = ctypes.c_char_p()
+        self._handle = lib.ft_lighthouse_client_new(
+            addr.encode(), ctypes.byref(err)
+        )
+        check_error(err)
+        if not self._handle:
+            raise RuntimeError("failed to create lighthouse client")
+
+    def heartbeat(
+        self,
+        replica_id: "str | List[str]",
+        timeout: "float | timedelta" = 5.0,
+    ) -> None:
+        """Heartbeat one replica id, or a whole batch in ONE RPC (a list
+        posts the ``replica_ids`` wire form — the per-domain aggregation
+        that cuts steady-state heartbeat RPCs ~len(batch)x)."""
+        err = ctypes.c_char_p()
+        get_lib().ft_lighthouse_client_heartbeat2(
+            self._handle,
+            json.dumps(replica_id).encode(),
+            _ms(timeout),
+            ctypes.byref(err),
+        )
+        check_error(err)
+
+    def quorum(
+        self, requester: dict, timeout: "float | timedelta" = 60.0
+    ) -> dict:
+        err = ctypes.c_char_p()
+        ptr = get_lib().ft_lighthouse_client_quorum2(
+            self._handle,
+            json.dumps(requester).encode(),
+            _ms(timeout),
+            ctypes.byref(err),
+        )
+        check_error(err)
+        return json.loads(take_string(ptr))
+
+    def __del__(self) -> None:
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle:
+            try:
+                get_lib().ft_lighthouse_client_free(handle)
+            except Exception:
+                pass  # interpreter teardown
+
+
+def quorum_compute_raw(now_ms: int, state_json: str, opts: dict) -> str:
+    """Run the pure decision kernel over a dumped QuorumState, returning
+    the RAW decision JSON string — the byte-identity oracle against
+    ``IncrementalQuorum.decision``."""
+    err = ctypes.c_char_p()
+    ptr = get_lib().ft_quorum_compute(
+        now_ms,
+        state_json.encode(),
+        json.dumps(opts).encode(),
+        ctypes.byref(err),
+    )
+    check_error(err)
+    return take_string(ptr)
+
+
+class IncrementalQuorum:
+    """Driver over the native incremental quorum evaluator
+    (ftquorum::IncrementalQuorum) — the epoch-cached decision plane the
+    lighthouse serves at fleet scale. Exposed so property tests and
+    ``scripts/bench_fleet.py`` can replay arbitrary heartbeat/join/
+    expiry/install sequences and pin ``decision()`` byte-identical to a
+    from-scratch ``quorum_compute_raw`` over ``state()``.
+
+    ``now_ms`` arguments must be non-decreasing across calls (the
+    lighthouse feeds a monotonic clock)."""
+
+    def __init__(
+        self,
+        opts: Optional[dict] = None,
+        incremental: bool = True,
+        prune_after_ms: int = 0,
+    ) -> None:
+        lib = get_lib()
+        err = ctypes.c_char_p()
+        self._handle = lib.ft_iq_new(
+            json.dumps(opts or {}).encode(),
+            1 if incremental else 0,
+            prune_after_ms,
+            ctypes.byref(err),
+        )
+        check_error(err)
+        if not self._handle:
+            raise RuntimeError("failed to create incremental quorum")
+
+    def heartbeat(self, replica_id: str, now_ms: int) -> None:
+        get_lib().ft_iq_heartbeat(self._handle, replica_id.encode(), now_ms)
+
+    def join(self, joined_ms: int, member: dict) -> None:
+        err = ctypes.c_char_p()
+        get_lib().ft_iq_join(
+            self._handle, joined_ms, json.dumps(member).encode(),
+            ctypes.byref(err),
+        )
+        check_error(err)
+
+    def decision(self, now_ms: int) -> str:
+        """RAW decision JSON ({"quorum": [...]|null, "reason": ...}) —
+        returned unparsed so byte-level comparison is possible."""
+        err = ctypes.c_char_p()
+        ptr = get_lib().ft_iq_decision(
+            self._handle, now_ms, ctypes.byref(err)
+        )
+        check_error(err)
+        return take_string(ptr)
+
+    def install(self, now_ms: int, wall_ms: int = 0) -> dict:
+        """Install the current decision as prev_quorum when ready (the
+        lighthouse announcement step). {"installed": bool, "quorum_id"}."""
+        err = ctypes.c_char_p()
+        ptr = get_lib().ft_iq_install(
+            self._handle, now_ms, wall_ms, ctypes.byref(err)
+        )
+        check_error(err)
+        return json.loads(take_string(ptr))
+
+    def state(self) -> str:
+        """RAW QuorumState JSON in the shape quorum_compute_raw consumes."""
+        err = ctypes.c_char_p()
+        ptr = get_lib().ft_iq_state(self._handle, ctypes.byref(err))
+        check_error(err)
+        return take_string(ptr)
+
+    def counters(self) -> dict:
+        err = ctypes.c_char_p()
+        ptr = get_lib().ft_iq_counters(self._handle, ctypes.byref(err))
+        check_error(err)
+        return json.loads(take_string(ptr))
+
+    def __del__(self) -> None:
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle:
+            try:
+                get_lib().ft_iq_free(handle)
+            except Exception:
+                pass  # interpreter teardown
+
+
 def lighthouse_heartbeat(
     lighthouse_addr: str, replica_id: str, timeout: "float | timedelta" = 5.0
 ) -> None:
+    """One-shot heartbeat (thin wrapper; prefer LighthouseClient for
+    long-lived callers)."""
     err = ctypes.c_char_p()
     get_lib().ft_lighthouse_client_heartbeat(
         lighthouse_addr.encode(), replica_id.encode(), _ms(timeout),
@@ -305,7 +498,8 @@ def lighthouse_quorum(
     requester: dict,
     timeout: "float | timedelta" = 60.0,
 ) -> dict:
-    """Direct lighthouse quorum RPC (used by tests/tools)."""
+    """Direct lighthouse quorum RPC (one-shot thin wrapper; used by
+    tests/tools)."""
     err = ctypes.c_char_p()
     ptr = get_lib().ft_lighthouse_client_quorum(
         lighthouse_addr.encode(),

@@ -75,9 +75,26 @@ class Lighthouse:
         quorum_tick_ms: Optional[int] = ...,
         heartbeat_timeout_ms: Optional[int] = ...,
         hostname: str = ...,
+        cache_quorum: bool = ...,
+        prune_after_ms: Optional[int] = ...,
+        tier: Optional[int] = ...,
+        domain: Optional[str] = ...,
+        upstream_addr: Optional[str] = ...,
+        upstream_report_interval_ms: Optional[int] = ...,
     ) -> None: ...
     def address(self) -> str: ...
     def shutdown(self) -> None: ...
+
+class LighthouseClient:
+    def __init__(self, addr: str) -> None: ...
+    def heartbeat(
+        self,
+        replica_id: "str | List[str]",
+        timeout: "float | timedelta" = ...,
+    ) -> None: ...
+    def quorum(
+        self, requester: dict, timeout: "float | timedelta" = ...
+    ) -> dict: ...
 
 def lighthouse_heartbeat(
     lighthouse_addr: str, replica_id: str,

@@ -60,7 +60,8 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.ft_free.restype = None
 
     lib.ft_lighthouse_new.argtypes = [
-        c_char_p, c_int, c_char_p, c_u64, c_u64, c_u64, c_u64, err_p,
+        c_char_p, c_int, c_char_p, c_u64, c_u64, c_u64, c_u64, c_char_p,
+        err_p,
     ]
     lib.ft_lighthouse_new.restype = c_void_p
     lib.ft_lighthouse_address.argtypes = [c_void_p]
@@ -111,6 +112,20 @@ def _configure(lib: ctypes.CDLL) -> None:
         c_char_p, c_char_p, c_u64, err_p,
     ]
     lib.ft_lighthouse_client_quorum.restype = c_void_p
+    # Persistent lighthouse client handles (pooled keep-alive; the
+    # one-shot functions above remain as thin compatibility wrappers).
+    lib.ft_lighthouse_client_new.argtypes = [c_char_p, err_p]
+    lib.ft_lighthouse_client_new.restype = c_void_p
+    lib.ft_lighthouse_client_free.argtypes = [c_void_p]
+    lib.ft_lighthouse_client_free.restype = None
+    lib.ft_lighthouse_client_heartbeat2.argtypes = [
+        c_void_p, c_char_p, c_u64, err_p,
+    ]
+    lib.ft_lighthouse_client_heartbeat2.restype = c_int
+    lib.ft_lighthouse_client_quorum2.argtypes = [
+        c_void_p, c_char_p, c_u64, err_p,
+    ]
+    lib.ft_lighthouse_client_quorum2.restype = c_void_p
 
     lib.ft_quorum_compute.argtypes = [c_i64, c_char_p, c_char_p, err_p]
     lib.ft_quorum_compute.restype = c_void_p
@@ -118,6 +133,24 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.ft_compute_quorum_results.restype = c_void_p
     lib.ft_json_roundtrip.argtypes = [c_char_p, err_p]
     lib.ft_json_roundtrip.restype = c_void_p
+
+    # Incremental-quorum driver (property tests / bench_fleet oracle).
+    lib.ft_iq_new.argtypes = [c_char_p, c_int, c_i64, err_p]
+    lib.ft_iq_new.restype = c_void_p
+    lib.ft_iq_free.argtypes = [c_void_p]
+    lib.ft_iq_free.restype = None
+    lib.ft_iq_heartbeat.argtypes = [c_void_p, c_char_p, c_i64]
+    lib.ft_iq_heartbeat.restype = None
+    lib.ft_iq_join.argtypes = [c_void_p, c_i64, c_char_p, err_p]
+    lib.ft_iq_join.restype = c_int
+    lib.ft_iq_decision.argtypes = [c_void_p, c_i64, err_p]
+    lib.ft_iq_decision.restype = c_void_p
+    lib.ft_iq_install.argtypes = [c_void_p, c_i64, c_i64, err_p]
+    lib.ft_iq_install.restype = c_void_p
+    lib.ft_iq_state.argtypes = [c_void_p, err_p]
+    lib.ft_iq_state.restype = c_void_p
+    lib.ft_iq_counters.argtypes = [c_void_p, err_p]
+    lib.ft_iq_counters.restype = c_void_p
 
 
 def get_lib() -> ctypes.CDLL:

@@ -45,6 +45,30 @@ def main(argv=None) -> int:
         "--hostname", default="",
         help="advertised hostname (default: machine hostname)",
     )
+    parser.add_argument(
+        "--no-cache-quorum", action="store_true",
+        help="disable epoch-cached quorum decisions (A/B/debug only: "
+             "recomputes the full decision on every evaluation)",
+    )
+    parser.add_argument(
+        "--prune_after_ms", type=int, default=0,
+        help="prune heartbeat/participant entries dead longer than this "
+             "(0: 12x heartbeat_timeout_ms)",
+    )
+    parser.add_argument(
+        "--domain", default="",
+        help="domain (rack/ICI) name — makes this a tier-1 aggregator "
+             "when --upstream is set",
+    )
+    parser.add_argument(
+        "--upstream", default="",
+        help="root lighthouse address to report this domain's membership "
+             "summary to (two-level tree)",
+    )
+    parser.add_argument(
+        "--upstream_report_interval_ms", type=int, default=500,
+        help="DomainReport cadence to the root",
+    )
     args = parser.parse_args(argv)
 
     import socket
@@ -58,8 +82,20 @@ def main(argv=None) -> int:
         quorum_tick_ms=args.quorum_tick_ms,
         heartbeat_timeout_ms=args.heartbeat_timeout_ms,
         hostname=args.hostname or socket.gethostname(),
+        cache_quorum=not args.no_cache_quorum,
+        prune_after_ms=args.prune_after_ms or None,
+        domain=args.domain or None,
+        upstream_addr=args.upstream or None,
+        upstream_report_interval_ms=args.upstream_report_interval_ms,
     )
+    # NOTE: tooling parses this exact line (address = last token).
     print(f"lighthouse serving at {lighthouse.address()}", flush=True)
+    if args.upstream:
+        print(
+            f"tier-1 aggregator for domain {args.domain!r}, reporting to "
+            f"{args.upstream}",
+            flush=True,
+        )
 
     stop = threading.Event()
 

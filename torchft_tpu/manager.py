@@ -245,10 +245,15 @@ class Manager:
         self._comm = comm
         self._manager: Optional[ManagerServer] = None
 
+        # Which lighthouse this group is homed to (a tier-1 domain
+        # aggregator in a two-level tree, or the root) — surfaced via
+        # /telemetry so fleet_top can group replica rows by domain.
+        self._lighthouse_addr: Optional[str] = None
         if self._rank == 0:
             if port is None:
                 port = int(os.environ.get(MANAGER_PORT_ENV, 0))
             lighthouse_addr = lighthouse_addr or os.environ[LIGHTHOUSE_ENV]
+            self._lighthouse_addr = lighthouse_addr
             replica_id = (replica_id or "") + str(uuid.uuid4())
             self._manager = ManagerServer(
                 replica_id=replica_id,
@@ -629,6 +634,9 @@ class Manager:
             "participating": self._participating_rank is not None,
             "healing": self._healing,
             "batches_committed": self._batches_committed,
+            # group's lighthouse (domain aggregator or root); None on
+            # ranks that don't own the ManagerServer
+            "lighthouse_addr": self._lighthouse_addr,
         }
 
     # ---------------------------------------------------------- error model
