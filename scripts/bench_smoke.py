@@ -267,6 +267,140 @@ def xla_smoke() -> "list[str]":
     return failures
 
 
+# One in-process QUANTIZED-PSUM round (the ISSUE 11 gate), exec'd in a
+# child for the forced device count. Three rounds of one layout so the
+# compile cache is actually exercised; prints compile/trace counts, the
+# encoded-bytes counters, and the numeric error vs the exact f64 sum.
+_QPSUM_SMOKE = r"""
+import json, sys, threading
+import numpy as np
+sys.path.insert(0, sys.argv[1])
+from torchft_tpu.comm.xla_backend import MeshManager, XlaCommContext
+
+world = 2
+mm = MeshManager()
+ctxs = [
+    XlaCommContext(timeout=30.0, algorithm="psum", compression="int8",
+                   chunk_bytes=1 << 20, mesh_manager=mm)
+    for _ in range(world)
+]
+rng = np.random.default_rng(0)
+srcs = [
+    (rng.standard_normal(1 << 16) * (r + 1)).astype(np.float32)
+    for r in range(world)
+]
+last = [None] * world
+errs = []
+
+def worker(rank):
+    try:
+        ctx = ctxs[rank]
+        ctx.configure("xla://qpsum_smoke", rank, world)
+        for _ in range(3):
+            data = srcs[rank].copy()
+            ctx.allreduce([data]).future().result(timeout=60)
+        last[rank] = data
+    except Exception as e:
+        errs.append(repr(e))
+
+threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=180)
+payload = {"errors": errs, "compile_count": mm.compile_count,
+           "trace_count": mm.trace_count}
+if not errs:
+    exact = np.sum(srcs, axis=0, dtype=np.float64)
+    absmax = float(max(np.abs(s).max() for s in srcs))
+    payload["max_abs_err"] = float(np.abs(last[0] - exact).max())
+    payload["err_bound"] = (world + 1) * absmax / 100.0
+    snap = ctxs[0].metrics.snapshot()
+    payload["gauges"] = {
+        k: snap.get(k)
+        for k in ("comm_backend", "comm_encoded_bytes", "comm_raw_bytes")
+    }
+print(json.dumps(payload))
+for c in ctxs:
+    c.shutdown()
+"""
+
+
+def quantized_psum_smoke() -> "list[str]":
+    """One in-process quantized-psum round under a forced host device
+    count: fails on missing/non-finite encoded-bytes gauges, an
+    encoded/raw ratio above the int8 envelope (0.3 at the 1MB grid),
+    compile_count != 1 across repeated rounds (a retrace storm), or a
+    reduction outside the quantization-error bound."""
+    import math
+
+    env = {
+        k: v for k, v in os.environ.items() if k not in ("PYTHONPATH",)
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    out = None
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _QPSUM_SMOKE, _REPO],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, timeout=300,
+        )
+        payload = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        stderr = getattr(e, "stderr", None)
+        if stderr is None and out is not None:
+            stderr = out.stderr
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+        tail = (stderr or "").strip()[-2000:]
+        suffix = f"\n  child stderr: {tail}" if tail else ""
+        return [
+            f"quantized psum smoke: child failed to produce JSON: "
+            f"{e!r}{suffix}"
+        ]
+    failures = [
+        f"quantized psum smoke: {e}" for e in payload.get("errors", [])
+    ]
+    if failures:
+        return failures
+    if payload.get("compile_count") != 1 or payload.get("trace_count") != 1:
+        failures.append(
+            "quantized psum smoke: expected exactly 1 compile/trace for "
+            "3 rounds of one layout, got "
+            f"compile={payload.get('compile_count')} "
+            f"trace={payload.get('trace_count')}"
+        )
+    gauges = payload.get("gauges", {})
+    for key in ("comm_encoded_bytes", "comm_raw_bytes"):
+        v = gauges.get(key)
+        if v is None or not math.isfinite(float(v)) or float(v) <= 0:
+            failures.append(
+                f"quantized psum smoke: gauge {key!r} missing/non-finite: "
+                f"{v!r}"
+            )
+    if not failures:
+        ratio = float(gauges["comm_encoded_bytes"]) / float(
+            gauges["comm_raw_bytes"]
+        )
+        if ratio > 0.3:
+            failures.append(
+                "quantized psum smoke: encoded/raw bytes ratio "
+                f"{ratio:.4f} > 0.3 — the int8 wire is not compressing"
+            )
+        err = payload.get("max_abs_err")
+        bound = payload.get("err_bound")
+        if err is None or not math.isfinite(float(err)) or err > bound:
+            failures.append(
+                f"quantized psum smoke: reduction error {err!r} outside "
+                f"the quantization envelope {bound!r}"
+            )
+    return failures
+
+
 def events_smoke() -> "list[str]":
     """One in-process flight-recorder round: a solo Manager over a live
     lighthouse runs two committed steps, its event ring is dumped, and
@@ -495,6 +629,7 @@ def main() -> int:
     failures = heal_smoke()
     failures += diloco_smoke()
     failures += xla_smoke()
+    failures += quantized_psum_smoke()
     failures += events_smoke()
     failures += sharded_smoke()
     failures += fleet_smoke()
@@ -553,8 +688,8 @@ def main() -> int:
         f"comm_backend={payload.get('comm_backend')} "
         f"events_recorded={payload.get('t1_events_recorded')} "
         f"opt_state_ratio={(payload.get('sharded') or {}).get('state_bytes_ratio')} "
-        "heal_gauges=ok outer_gauges=ok xla_gauges=ok chrome_trace=ok "
-        "sharded_gauges=ok fleet_gauges=ok"
+        "heal_gauges=ok outer_gauges=ok xla_gauges=ok qpsum_gauges=ok "
+        "chrome_trace=ok sharded_gauges=ok fleet_gauges=ok"
     )
     return 0
 

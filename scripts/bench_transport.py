@@ -23,12 +23,21 @@ the deployment topology.
                                                        # backend instead
     python scripts/bench_transport.py --backend-ab 3   # host vs xla,
                                                        # rep-interleaved
+    python scripts/bench_transport.py --backend-ab 3 --codec int8
+                             # + the quantized-psum arm: quant vs raw
+                             # psum with an encoded-bytes-on-wire oracle
 
 --backend-ab runs the host (socket) and xla (on-device jax.lax,
 comm/xla_backend.py) data planes against identical seeded payloads,
 alternated rep-for-rep, with a BITWISE oracle every rep: both arms must
 produce byte-identical reduced results for every codec at the same
-chunk grid, or the run fails. Both arms use the SAME harness — one
+chunk grid, or the run fails. Adding --codec restricts the codec grid
+AND appends the quantized-psum sweep arm (xla-only — the shared
+capability query says the host plane has no psum): quantized vs raw
+psum, rep-interleaved, graded by the comm_encoded_bytes/comm_raw_bytes
+counters (int8 must be <= 0.3x raw at the 1MB grid), a numeric
+envelope vs the exact f64 sum (psum cannot enter the bitwise oracle —
+XLA owns its reduction order), and a 1-compile-per-child pin. Both arms use the SAME harness — one
 process per cell, one thread per rank (the xla group is in-process by
 construction) — so cells are comparable to each other but NOT to the
 process-per-rank cells above: the host arm's rank threads share a GIL
@@ -194,11 +203,25 @@ if errs:
     print(json.dumps({"error": "; ".join(errs)}))
     sys.exit(1)
 snap = ctxs[0].metrics.snapshot()
-print(json.dumps({
+payload = {
     "lat": lat, "digest": digest[0],
     "comm_backend": snap.get("comm_backend"),
     "comm_op_wire_avg_ms": snap.get("comm_op_wire_avg_ms"),
-}))
+    # bytes-on-wire counters (one rank's cumulative raw vs encoded
+    # contributions) — the --codec sweep's compression oracle
+    "comm_encoded_bytes": snap.get("comm_encoded_bytes"),
+    "comm_raw_bytes": snap.get("comm_raw_bytes"),
+}
+if backend == "xla":
+    from torchft_tpu.comm.xla_backend import default_mesh_manager
+    payload["compile_count"] = default_mesh_manager().compile_count
+if spec.get("check_numeric"):
+    # numeric oracle for order-free paths (psum): rank 0's reduced
+    # bytes vs the exact f64 sum of the seeded inputs
+    exact = np.sum([s.astype(np.float64) for s in srcs], axis=0)
+    payload["max_abs_err"] = float(np.max(np.abs(datas[0] - exact)))
+    payload["absmax"] = float(max(np.abs(s).max() for s in srcs))
+print(json.dumps(payload))
 for c in ctxs:
     c.shutdown()
 """
@@ -438,9 +461,11 @@ def _overlap_ab(store, payload_mb: int, iters_override, buckets: int,
 
 def _thread_cell(store, backend, algorithm, world, nbytes, iters, warmup,
                  channels=4, chunk_bytes=1 << 20, compression="none",
-                 seed=0, env=None):
+                 seed=0, env=None, check_numeric=False):
     """One thread-per-rank cell (see _THREAD_WORKER). Returns latency
-    percentiles + the rank-0 result digest (the bitwise oracle)."""
+    percentiles + the rank-0 result digest (the bitwise oracle) + the
+    bytes-on-wire counters; ``check_numeric`` adds the max-abs-err
+    oracle for order-free (psum) cells."""
     import os
 
     _CELL_SEQ[0] += 1
@@ -451,6 +476,7 @@ def _thread_cell(store, backend, algorithm, world, nbytes, iters, warmup,
         "world": world, "algorithm": algorithm, "channels": channels,
         "chunk_bytes": chunk_bytes, "compression": compression,
         "nbytes": nbytes, "iters": iters, "warmup": warmup, "seed": seed,
+        "check_numeric": bool(check_numeric),
     }
     child_env = dict(os.environ)
     child_env.pop("PYTHONPATH", None)
@@ -486,10 +512,15 @@ def _thread_cell(store, backend, algorithm, world, nbytes, iters, warmup,
     res = _percentiles(payload["lat"])
     res["digest"] = payload["digest"]
     res["comm_backend"] = payload["comm_backend"]
+    for key in ("comm_encoded_bytes", "comm_raw_bytes", "compile_count",
+                "max_abs_err", "absmax"):
+        if payload.get(key) is not None:
+            res[key] = payload[key]
     return res
 
 
-def _backend_ab(store, payload_mb: int, iters_override, reps: int) -> list:
+def _backend_ab(store, payload_mb: int, iters_override, reps: int,
+                codecs=("none", "bf16", "int8")) -> list:
     """Rep-interleaved host-vs-xla A/B with a bitwise oracle every rep
     (PR 2-5 pattern: warmup reps inside each cell, gc outside windows,
     arms alternated so host-load drift hits both equally). Fails loudly
@@ -502,7 +533,7 @@ def _backend_ab(store, payload_mb: int, iters_override, reps: int) -> list:
         dict(algorithm=algorithm, world=world, compression=codec,
              label=f"{algorithm}_w{world}_{codec}")
         for algorithm, world in (("star", 2), ("ring", 3))
-        for codec in ("none", "bf16", "int8")
+        for codec in codecs
     ]
     runs: dict = {c["label"]: {"host": [], "xla": []} for c in configs}
     oracle_ok = True
@@ -552,6 +583,119 @@ def _backend_ab(store, payload_mb: int, iters_override, reps: int) -> list:
     return cells
 
 
+# Encoded/raw envelopes for the quantized-psum arm: int8 = 1B payload +
+# 4B scale per (1MB) chunk over 4B elems; bf16/fp16 = 2B payload. A
+# quant arm above its envelope means the wire stopped compressing.
+_PSUM_RATIO_ENVELOPE = {"int8": 0.30, "bf16": 0.51, "fp16": 0.51}
+# Numeric envelopes: max abs error of the reduced SUM vs the exact f64
+# sum, as a fraction of (world+1)*absmax — int8's per-element error is
+# absmax/254 per contribution plus the phase-2 re-encode, bf16 keeps 8
+# mantissa bits, fp16 10.
+_PSUM_ERR_DIV = {"int8": 100.0, "bf16": 100.0, "fp16": 400.0}
+
+
+def _psum_codec_cells(store, payload_mb: int, iters_override, reps: int,
+                      codecs) -> list:
+    """The --codec sweep arm of --backend-ab: quantized psum vs raw
+    psum (both xla — the host plane has no psum, says the shared
+    capability query), rep-interleaved, with THREE oracles every rep:
+
+    * **encoded bytes on wire** (the graded one): the quant arm's
+      ``comm_encoded_bytes / comm_raw_bytes`` counter ratio must sit
+      inside the codec's envelope (int8 <= 0.3x at the 1MB grid) and
+      the raw arm's must be exactly 1.0;
+    * **numeric**: rank 0's reduced bytes within the codec's
+      quantization-error envelope of the exact f64 sum (psum cannot
+      enter the bitwise A/B — XLA owns the reduction order);
+    * **compile**: exactly 1 executable per child (one layout — more
+      means a retrace storm).
+
+    Fails the run loudly on any oracle miss."""
+    import gc
+
+    from torchft_tpu.comm.xla_backend import XlaCommContext
+
+    nbytes = payload_mb << 20
+    iters = iters_override or 8
+    world = 2
+    cells = []
+    failures = []
+    for codec in [c for c in codecs if c != "none"]:
+        if not XlaCommContext.supports("psum", codec):
+            print(f"# psum_{codec}: unsupported, skipped", file=sys.stderr)
+            continue
+        runs = {"raw": [], "quant": []}
+        for rep in range(reps):
+            for arm, compression in (("raw", "none"), ("quant", codec)):
+                gc.collect()
+                res = _thread_cell(
+                    store, "xla", "psum", world, nbytes,
+                    iters=iters, warmup=2, compression=compression,
+                    seed=3000 + rep, check_numeric=True,
+                )
+                runs[arm].append(res)
+                ratio = res["comm_encoded_bytes"] / res["comm_raw_bytes"]
+                print(
+                    f"# rep{rep} psum_{codec} {arm}: "
+                    f"avg {res['avg_ms']:.1f}ms ratio {ratio:.4f} "
+                    f"err {res['max_abs_err']:.3g} "
+                    f"compiles {res.get('compile_count')}",
+                    file=sys.stderr,
+                )
+                if arm == "quant" and ratio > _PSUM_RATIO_ENVELOPE[codec]:
+                    failures.append(
+                        f"rep{rep} psum_{codec} quant: encoded/raw "
+                        f"{ratio:.4f} > {_PSUM_RATIO_ENVELOPE[codec]}"
+                    )
+                if arm == "raw" and abs(ratio - 1.0) > 1e-9:
+                    failures.append(
+                        f"rep{rep} psum_{codec} raw: encoded/raw "
+                        f"{ratio:.6f} != 1.0"
+                    )
+                err_div = (
+                    _PSUM_ERR_DIV[codec] if arm == "quant" else 1e5
+                )
+                bound = (world + 1) * res["absmax"] / err_div
+                if res["max_abs_err"] > bound:
+                    failures.append(
+                        f"rep{rep} psum_{codec} {arm}: err "
+                        f"{res['max_abs_err']:.4g} > bound {bound:.4g}"
+                    )
+                if res.get("compile_count") != 1:
+                    failures.append(
+                        f"rep{rep} psum_{codec} {arm}: "
+                        f"{res.get('compile_count')} compiles for one "
+                        "layout (retrace storm)"
+                    )
+        cell = {
+            "label": f"psum_w{world}_{codec}", "algorithm": "psum",
+            "world": world, "compression": codec,
+            "payload_bytes": nbytes, "iters": iters, "reps": reps,
+            "workers": "thread-per-rank",
+            "ratio_envelope": _PSUM_RATIO_ENVELOPE[codec],
+        }
+        for arm in ("raw", "quant"):
+            avgs = sorted(r["avg_ms"] for r in runs[arm])
+            cell[f"{arm}_median_avg_ms"] = round(avgs[len(avgs) // 2], 3)
+            cell[f"{arm}_rep_avg_ms"] = [round(a, 3) for a in avgs]
+            cell[f"{arm}_encoded_ratio"] = round(
+                runs[arm][-1]["comm_encoded_bytes"]
+                / runs[arm][-1]["comm_raw_bytes"], 4
+            )
+            cell[f"{arm}_max_abs_err"] = max(
+                r["max_abs_err"] for r in runs[arm]
+            )
+        cell["encoded_bytes_oracle"] = not any(
+            "encoded/raw" in f for f in failures
+        )
+        cells.append(cell)
+    if failures:
+        raise SystemExit(
+            "psum --codec sweep: oracle FAILED:\n  " + "\n  ".join(failures)
+        )
+    return cells
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true", help="add 32MB payloads")
@@ -591,7 +735,17 @@ def main() -> None:
         help="host-vs-xla A/B at --sweep-payload-mb, alternated N reps "
         "with a bitwise oracle every rep (both arms thread-per-rank)",
     )
+    ap.add_argument(
+        "--codec", action="append", default=None, metavar="CODEC",
+        choices=("none", "bf16", "fp16", "int8"),
+        help="with --backend-ab: restrict the star/ring codec grid to "
+        "these codecs AND add the quantized-psum sweep arm (quant vs "
+        "raw psum, xla only, rep-interleaved) with an encoded-bytes-"
+        "on-wire + numeric + compile-count oracle per rep; repeatable",
+    )
     args = ap.parse_args()
+    if args.codec and not args.backend_ab:
+        ap.error("--codec applies only to --backend-ab")
     if args.backend == "xla" and (
         args.stripe_sweep or args.overlap_ab
         or (args.ab_repeat and args.ab_baseline)
@@ -609,9 +763,18 @@ def main() -> None:
     store = StoreServer()
     try:
         if args.backend_ab:
+            codecs = tuple(args.codec) if args.codec else (
+                "none", "bf16", "int8"
+            )
             cells = _backend_ab(
                 store, args.sweep_payload_mb, args.iters, args.backend_ab,
+                codecs=codecs,
             )
+            if args.codec:
+                cells += _psum_codec_cells(
+                    store, args.sweep_payload_mb, args.iters,
+                    args.backend_ab, codecs,
+                )
         elif args.overlap_ab:
             cells = _overlap_ab(
                 store, args.sweep_payload_mb, args.iters,

@@ -621,8 +621,20 @@ def test_psum_algorithm_runs(mesh_mgr) -> None:
 
 
 def test_validation_errors() -> None:
-    with pytest.raises(ValueError, match="cannot carry a wire codec"):
-        XlaCommContext(algorithm="psum", compression="int8")
+    # psum + lossy codec is now a SUPPORTED combo (the quantized native
+    # exchange, tests/test_quantized_psum.py); construction must succeed
+    # and the capability query must agree. Only op-dependent combos
+    # (max/min over block scales) remain unsupported — prescriptively.
+    ctx = XlaCommContext(algorithm="psum", compression="int8")
+    assert ctx.supports("psum", "int8") and ctx.wire_codec_name() == "int8"
+    assert not XlaCommContext.supports("psum", "int8", ReduceOp.MAX)
+    assert "only ACCUMULATES" in XlaCommContext.unsupported_reason(
+        "psum", "int8", ReduceOp.MAX
+    )
+    # the host plane has no psum at all — one shared definition says so
+    assert not TcpCommContext.supports("psum", "none")
+    with pytest.raises(ValueError, match="no psum"):
+        TcpCommContext(algorithm="psum")
     with pytest.raises(ValueError, match="unknown algorithm"):
         XlaCommContext(algorithm="tree")
     with pytest.raises(ValueError, match="unknown compression"):

@@ -115,6 +115,32 @@ class CommContext(ABC):
         self._rank = 0
         self._world_size = 1
 
+    # ------------------------------------------------- capability query
+    # ONE definition of which (algorithm, compression, op) combos each
+    # backend can run, shared by ctor validation, Manager.comm_options
+    # and the bench sweeps (scripts/bench_transport.py) — so "can the
+    # psum path carry int8?" has exactly one answer everywhere instead
+    # of a hard ValueError here and a drifted copy there.
+
+    @classmethod
+    def unsupported_reason(
+        cls, algorithm: str, compression: str, op: str = ReduceOp.SUM
+    ) -> Optional[str]:
+        """``None`` when this backend can run ``algorithm`` with
+        ``compression`` for reduce op ``op``; otherwise a PRESCRIPTIVE
+        error string (what to use instead). Real data planes override;
+        identity/test contexts move no bytes, so every combo is a
+        no-op they "support"."""
+        return None
+
+    @classmethod
+    def supports(
+        cls, algorithm: str, compression: str, op: str = ReduceOp.SUM
+    ) -> bool:
+        """Capability query: True when :meth:`unsupported_reason` is
+        ``None`` for the combo."""
+        return cls.unsupported_reason(algorithm, compression, op) is None
+
     @staticmethod
     def _prepare(a) -> np.ndarray:
         """Donation contract: ALLREDUCE reduces in place, so the submitted
@@ -363,6 +389,18 @@ class ErrorSwallowingCommContext(CommContext):
     def wire_nbytes(self, a: np.ndarray) -> int:
         return self._inner.wire_nbytes(a)
 
+    # instance-level shadow of the classmethod: capability follows the
+    # wrapped backend, not this wrapper's (identity) default
+    def unsupported_reason(  # type: ignore[override]
+        self, algorithm: str, compression: str, op: str = ReduceOp.SUM
+    ) -> Optional[str]:
+        return self._inner.unsupported_reason(algorithm, compression, op)
+
+    def supports(  # type: ignore[override]
+        self, algorithm: str, compression: str, op: str = ReduceOp.SUM
+    ) -> bool:
+        return self._inner.supports(algorithm, compression, op)
+
 
 class ManagedCommContext(CommContext):
     """Context that routes every collective through a Manager so errors and
@@ -431,3 +469,15 @@ class ManagedCommContext(CommContext):
 
     def wire_nbytes(self, a: np.ndarray) -> int:
         return self._manager.wire_nbytes(a)
+
+    def unsupported_reason(  # type: ignore[override]
+        self, algorithm: str, compression: str, op: str = ReduceOp.SUM
+    ) -> Optional[str]:
+        return self._manager.comm_unsupported_reason(
+            algorithm, compression, op
+        )
+
+    def supports(  # type: ignore[override]
+        self, algorithm: str, compression: str, op: str = ReduceOp.SUM
+    ) -> bool:
+        return self.unsupported_reason(algorithm, compression, op) is None

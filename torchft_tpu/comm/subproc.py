@@ -186,6 +186,15 @@ class SubprocessCommContext(CommContext):
         self._lock = threading.Lock()
         self._error: Optional[Exception] = None
 
+    @classmethod
+    def unsupported_reason(cls, algorithm: str, compression: str,
+                           op: str = ReduceOp.SUM) -> "Optional[str]":
+        # The child owns a TcpCommContext — capability IS the host
+        # plane's (one shared definition, transport.py).
+        from torchft_tpu.comm.transport import host_unsupported_reason
+
+        return host_unsupported_reason(algorithm, compression, op)
+
     # ------------------------------------------------------------ lifecycle
 
     def configure(self, store_addr: str, rank: int, world_size: int) -> None:

@@ -87,7 +87,12 @@ from torchft_tpu.utils.metrics import Metrics
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["TcpCommContext", "codec_roundtrip", "codec_wire_nbytes"]
+__all__ = [
+    "TcpCommContext",
+    "codec_roundtrip",
+    "codec_wire_nbytes",
+    "host_unsupported_reason",
+]
 
 _OP_ALLREDUCE = 1
 _OP_ALLGATHER = 2
@@ -678,6 +683,29 @@ def codec_roundtrip(codec, chunk_bytes: int, src: np.ndarray,
         codec.decode_into(
             _iov_join(codec.encode_iovecs([ch_s])), [ch_o], copy
         )
+
+
+def host_unsupported_reason(algorithm: str, compression: str,
+                            op: str = ReduceOp.SUM) -> "Optional[str]":
+    """THE host-plane capability rule (CommContext.unsupported_reason):
+    shared by TcpCommContext and its subprocess proxy so the two can
+    never drift. The socket transport runs every codec on star/ring/auto
+    for every reduce op; ``psum`` is the on-device hardware-native path
+    and does not exist on sockets."""
+    if algorithm == "psum":
+        return (
+            "algorithm='psum' is the on-device hardware-native path "
+            "(comm_backend='xla', comm/xla_backend.py); the host socket "
+            "transport has no psum — use algorithm='star'/'ring'/'auto' "
+            "here, or select the xla backend"
+        )
+    if algorithm not in ("auto", "star", "ring"):
+        return f"unknown algorithm {algorithm!r}"
+    if compression not in _CODECS:
+        return (
+            f"unknown compression {compression!r}; have {sorted(_CODECS)}"
+        )
+    return None
 
 
 def codec_wire_nbytes(codec, chunk_bytes: int, a: np.ndarray) -> int:
@@ -1331,17 +1359,13 @@ class TcpCommContext(CommContext):
         super().__init__()
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
-        if algorithm not in ("auto", "star", "ring"):
-            raise ValueError(f"unknown algorithm {algorithm!r}")
+        reason = self.unsupported_reason(algorithm, compression)
+        if reason is not None:
+            raise ValueError(reason)
         if channels < 1:
             raise ValueError("channels must be >= 1")
         if chunk_bytes < 0:
             raise ValueError("chunk_bytes must be >= 0")
-        if compression not in _CODECS:
-            raise ValueError(
-                f"unknown compression {compression!r}; "
-                f"have {sorted(_CODECS)}"
-            )
         self._codec = _CODECS[compression]()
         self._chunk_bytes = int(chunk_bytes)
         self._stripe = bool(stripe)
@@ -1362,6 +1386,11 @@ class TcpCommContext(CommContext):
         self.metrics = Metrics()
         self.metrics.label("comm_backend", self.backend_name)
         self._events = None  # flight recorder (set_events)
+
+    @classmethod
+    def unsupported_reason(cls, algorithm: str, compression: str,
+                           op: str = ReduceOp.SUM) -> Optional[str]:
+        return host_unsupported_reason(algorithm, compression, op)
 
     def set_metrics(self, metrics: Metrics) -> None:
         """Record lane phase timings into ``metrics`` (call before
@@ -1732,6 +1761,17 @@ class TcpCommContext(CommContext):
                 state = _OpState(prepared, fut, len(per_lane),
                                  self.metrics)
                 self.metrics.incr("comm_chunks", float(len(chunks)))
+                # Bytes-on-wire accounting (one direction, THIS rank's
+                # contribution): cumulative raw vs encoded counters so a
+                # compression ratio is a Δcounter division, not a guess.
+                # Same keys as the xla plane — codec honesty is a
+                # cross-backend invariant.
+                self.metrics.incr("comm_raw_bytes", float(sum(
+                    ch.nbytes for ch in chunks
+                )))
+                self.metrics.incr("comm_encoded_bytes", float(sum(
+                    self._codec.wire_nbytes(ch) for ch in chunks
+                )))
                 if len(per_lane) > 1:
                     self.metrics.incr("comm_striped_ops")
                 for lane_id in sorted(per_lane):

@@ -12,8 +12,13 @@ compute. ``XlaCommContext`` implements the same ``CommContext`` surface
 ALLREDUCE lowers to ``jax.lax.all_gather``/``psum`` inside ``shard_map``
 over a named mesh axis, with the PR 2 chunk grid and wire codecs
 (bf16/int8 + per-chunk scales) fused into the SAME jitted computation —
-encode → exchange → decode-accumulate as one executable, the first step
-toward EQuARX-style fused quantized collectives (ROADMAP item 2).
+encode → exchange → decode-accumulate as one executable. On the
+hardware-native ``psum`` path a lossy codec runs the EQuARX-style
+QUANTIZED exchange (:func:`_build_quantized_psum` /
+:func:`_build_quantized_psum_scatter`): block-quantize on the chunk
+grid → ``all_to_all`` of int8/bf16 payloads (+ compact f32 scales) →
+dequantize-accumulate → re-encode → ``all_gather``, so encoded bytes —
+not f32 — are what crosses every link (ROADMAP item 2, finished).
 
 Membership churn without retrace storms
 ---------------------------------------
@@ -107,7 +112,13 @@ from torchft_tpu.utils.metrics import Metrics
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["XlaCommContext", "MeshManager", "default_mesh_manager"]
+__all__ = [
+    "XlaCommContext",
+    "MeshManager",
+    "default_mesh_manager",
+    "device_codec_roundtrip",
+    "pallas_block_quant",
+]
 
 _AXIS = "replica"
 
@@ -286,6 +297,38 @@ def _hardround(x, z):
     )
 
 
+def _dev_quant_int8(x, z):
+    """``(q int8, scale f32)`` for ONE chunk view — THE device-side
+    int8 block quantizer, bit-matching the host ``_Int8Codec._quantize``
+    (transport.py): numpy computes the scale as f32(f64(absmax)/127.0);
+    the f64 divide (real, thanks to enable_x64 at trace time) plus the
+    hardrounds reproduce it exactly — see module docstring. Shared by
+    the enc-dec roundtrip (parity paths, EF image) and the quantized
+    psum exchange (phase-1 encode), so the residual the EF arena banks
+    is computed against the exact bytes the wire carries."""
+    import jax.numpy as jnp
+
+    absmax = jnp.max(jnp.abs(x))
+    scale64 = absmax.astype(jnp.float64) / np.float64(127.0)
+    scale = jnp.where(
+        absmax > 0, scale64, np.float64(1.0)
+    ).astype(jnp.float32)
+    scale = jnp.where(jnp.isfinite(absmax), scale, jnp.float32(np.nan))
+    scale = _hardround(scale, z)
+    q = jnp.clip(
+        jnp.rint(_hardround(x / scale, z)), -127, 127
+    ).astype(jnp.int8)
+    q = jnp.where(jnp.isfinite(absmax), q, jnp.int8(0))
+    return q, scale
+
+
+def _dev_dequant_int8(q, scale, z):
+    """``q * scale`` back to f32, hardrounded like the host decode."""
+    import jax.numpy as jnp
+
+    return _hardround(q.astype(jnp.float32) * scale, z)
+
+
 def _dev_enc_dec(codec_name: str, x, z):
     """decode(encode(x)) for one chunk view, bit-matching the host
     codec (transport.py) for f32 inputs; identity for dtypes the host
@@ -299,22 +342,37 @@ def _dev_enc_dec(codec_name: str, x, z):
     if codec_name == "fp16":
         return x.astype(jnp.float16).astype(jnp.float32)
     if codec_name == "int8":
-        # numpy computes the scale as f32(f64(absmax) / 127.0); the f64
-        # divide (real, thanks to enable_x64 at trace time) plus the
-        # hardrounds reproduce it exactly — see module docstring.
-        absmax = jnp.max(jnp.abs(x))
-        scale64 = absmax.astype(jnp.float64) / np.float64(127.0)
-        scale = jnp.where(
-            absmax > 0, scale64, np.float64(1.0)
-        ).astype(jnp.float32)
-        scale = jnp.where(jnp.isfinite(absmax), scale, jnp.float32(np.nan))
-        scale = _hardround(scale, z)
-        q = jnp.clip(
-            jnp.rint(_hardround(x / scale, z)), -127, 127
-        ).astype(jnp.int8)
-        q = jnp.where(jnp.isfinite(absmax), q, jnp.int8(0))
-        return _hardround(q.astype(jnp.float32) * scale, z)
+        return _dev_dequant_int8(*_dev_quant_int8(x, z), z)
     raise ValueError(f"unknown codec {codec_name!r}")
+
+
+def device_codec_roundtrip(codec_name: str, chunk_bytes: int,
+                           src: np.ndarray) -> np.ndarray:
+    """decode(encode(src)) computed ON DEVICE over the PR 2 chunk grid —
+    the device image of one wire contribution. Exists for the parity
+    tests: the host ``codec_roundtrip`` (transport.py) is what the EF
+    arena actually runs (wire_roundtrip), and this function is how the
+    suite PROVES the two are bit-identical at matching chunk grids, so
+    "the host codec path stays the convergence oracle" is a pinned
+    fact, not a hope."""
+    import jax
+    import jax.numpy as jnp
+
+    src = np.ascontiguousarray(src, dtype=np.float32).reshape(-1)
+    step = (
+        max(1, chunk_bytes // 4) if chunk_bytes > 0 else max(1, src.size)
+    )
+
+    def fn(z, x):
+        parts = [
+            _dev_enc_dec(codec_name, x[s: s + step], z)
+            for s in range(0, x.shape[0], step)
+        ]
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    with _x64_trace():
+        out = jax.jit(fn)(np.int32(0), src)
+    return np.asarray(out)
 
 
 def _is_float(dt) -> bool:
@@ -339,12 +397,7 @@ def _build_allreduce(mesh_mgr: MeshManager, world_size: int,
     lossy = codec_name != "none"
 
     def bounds_of(size: int, itemsize: int) -> List[Tuple[int, int]]:
-        if size == 0:
-            return []
-        if chunk_bytes <= 0:
-            return [(0, size)]
-        step = max(1, chunk_bytes // itemsize)  # _chunk_grid's step rule
-        return [(s, min(size, s + step)) for s in range(0, size, step)]
+        return _grid_bounds(size, chunk_bytes, itemsize)
 
     def comb(acc, new, z):
         # host: reduce_fn(left, incoming) writes into LEFT — star keeps
@@ -488,6 +541,330 @@ def _build_psum_scatter(mesh_mgr: MeshManager, world_size: int, op: str,
     return jax.jit(fn).lower(aval).compile(), row
 
 
+# ------------------------------------------------- quantized psum builders
+
+
+def _quant_impl() -> str:
+    """Which block-quantizer the quantized-psum builders trace:
+    ``"xla"`` (default — the per-chunk jnp loop XLA fuses into the
+    exchange) or ``"pallas"`` (TORCHFT_TPU_QPSUM_PALLAS=1 — one
+    hand-written kernel per payload, the fallback for block-scale
+    patterns XLA's fusion gives up on: very large chunk counts or
+    odd chunk/tile interactions on real TPUs). Part of the executable
+    cache key, so flipping the env mid-run compiles a new executable
+    instead of silently serving the old one."""
+    import os
+
+    return "pallas" if os.environ.get(
+        "TORCHFT_TPU_QPSUM_PALLAS", "0"
+    ) == "1" else "xla"
+
+
+def _pallas_quant_kernel(x_ref, q_ref, s_ref):
+    """One grid step = one block: absmax scale + int8 payload. Scale
+    math is f32 (pallas has no f64 path), so this quantizer is NUMERIC
+    parity with the host codec (scale can differ by 1 ulp, q by ±1),
+    not bitwise — the xla impl remains the bit-matched default."""
+    import jax.numpy as jnp
+
+    x = x_ref[...]
+    absmax = jnp.max(jnp.abs(x))
+    scale = jnp.where(
+        absmax > 0, absmax / np.float32(127.0), np.float32(1.0)
+    ).astype(jnp.float32)
+    scale = jnp.where(jnp.isfinite(absmax), scale, jnp.float32(np.nan))
+    q = jnp.clip(jnp.rint(x / scale), -127.0, 127.0).astype(jnp.int8)
+    q = jnp.where(jnp.isfinite(absmax), q, jnp.int8(0))
+    q_ref[...] = q
+    s_ref[...] = jnp.full((1, 1), scale, jnp.float32)
+
+
+def pallas_block_quant(x, step: int):
+    """Block-wise absmax int8 quantization of a flat f32 array as ONE
+    pallas kernel (grid = blocks of ``step`` elements — the PR 2 chunk
+    grid). Returns ``(q int8 (size,), scales f32 (n_blocks,))``.
+    Interpreted off-TPU (the CPU sandbox), compiled on real hardware.
+    The tail block is zero-padded for the kernel; zeros never raise an
+    absmax, so tail scales match the unpadded chunk's."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    size = x.shape[0]
+    blocks = max(1, -(-size // step))
+    padded = jnp.pad(x, (0, blocks * step - size))
+    q2, s2 = pl.pallas_call(
+        _pallas_quant_kernel,
+        grid=(blocks,),
+        in_specs=[pl.BlockSpec((1, step), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((1, step), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((blocks, step), jnp.int8),
+            jax.ShapeDtypeStruct((blocks, 1), jnp.float32),
+        ],
+        interpret=jax.default_backend() != "tpu",
+    )(padded.reshape(blocks, step))
+    return q2.reshape(-1)[:size], s2.reshape(-1)
+
+
+def _grid_bounds(size: int, chunk_bytes: int,
+                 itemsize: int = 4) -> List[Tuple[int, int]]:
+    """THE device-side chunk grid over one flat view (_chunk_grid's
+    step rule) — the int8 scale granularity. One definition shared by
+    _build_allreduce and both quantized builders, so no future edit
+    can move one builder's grid off the host codec's."""
+    if size == 0:
+        return []
+    if chunk_bytes <= 0:
+        return [(0, size)]
+    step = max(1, chunk_bytes // itemsize)
+    return [(s, min(size, s + step)) for s in range(0, size, step)]
+
+
+def _quantize_chunks(x, z, bounds, quant_impl: str):
+    """``(q int8 (size,), scales f32 (len(bounds),))`` over a non-empty
+    chunk-bound list — the ONE phase-1 quantizer dispatch shared by
+    :func:`_build_quantized_psum` and
+    :func:`_build_quantized_psum_scatter` (a fix to either impl lands
+    on both wires)."""
+    import jax.numpy as jnp
+
+    if quant_impl == "pallas":
+        step = bounds[0][1] - bounds[0][0]
+        return pallas_block_quant(x, step)
+    qs, scs = [], []
+    for s, e in bounds:
+        q, sc = _dev_quant_int8(x[s:e], z)
+        qs.append(q)
+        scs.append(sc)
+    return (
+        jnp.concatenate(qs) if len(qs) > 1 else qs[0]
+    ), jnp.stack(scs)
+
+
+def _build_quantized_psum(mesh_mgr: MeshManager, world_size: int,
+                          codec_name: str, chunk_bytes: int, op: str,
+                          layouts: Sequence[Tuple[int, np.dtype]],
+                          quant_impl: str = "xla"):
+    """Compile ONE quantized allreduce on the hardware-native exchange
+    path (EQuARX-style, ROADMAP item 2): for each f32 payload —
+
+    1. **quantize** this rank's contribution per chunk on the PR 2 grid
+       (int8 + one f32 scale per chunk; bf16/fp16 = elementwise astype),
+    2. **exchange** the ENCODED payload: ``all_to_all`` scatters int8
+       shards to their reducer (plus an ``all_gather`` of the compact
+       per-chunk scales — 4 bytes per 1MB chunk, noise), each link
+       carrying ~1/4 (int8) or ~1/2 (bf16) of the raw bytes,
+    3. **dequantize-accumulate** the received shards in f32 rank order,
+    4. **requantize** the reduced shard on the shard-local grid and
+       ``all_gather`` it encoded; every rank decodes identical bytes, so
+       the trajectory-consistency invariant holds (all replicas see the
+       SAME reduced values).
+
+    One executable, cached per ``(world, codec, chunk grid, op,
+    layouts, quant impl)`` like every PR 6 collective — a kill/reform
+    at a seen world size is a cache lookup, never a retrace. Like raw
+    ``psum``, XLA owns scheduling, so this path is NUMERIC (outside the
+    bitwise A/B); the phase-1 encode is bit-matched to the host codec
+    (shared ``_dev_quant_int8``), which is what makes the host
+    ``codec_roundtrip`` the honest EF image of this wire. Non-f32
+    device dtypes ride a raw ``psum`` branch uncompressed, exactly like
+    the host codecs' ``_is_compressible`` gate."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = world_size
+    mesh = mesh_mgr.mesh_for(n)
+    axis = mesh_mgr.axis_name
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError(
+            f"quantized psum only accumulates (sum/avg); got op={op!r}"
+        )
+
+    def reduce_int8(x, z, size, L, padn, d):
+        bounds = _grid_bounds(size, chunk_bytes)
+        lens = np.array([e - s for s, e in bounds])
+        q_full, scales = _quantize_chunks(x, z, bounds, quant_impl)
+        qt = lax.all_to_all(
+            jnp.pad(q_full, (0, padn)).reshape(n, L), axis, 0, 0
+        )
+        sc_all = lax.all_gather(scales, axis)
+        acc = jnp.zeros((L,), jnp.float32)
+        for r in range(n):
+            # expand rank r's compact scales to per-element over the
+            # full payload (static chunk lengths), then slice MY shard
+            # — all local math, zero extra wire bytes
+            sc_elem = jnp.repeat(
+                sc_all[r], jnp.asarray(lens), total_repeat_length=size
+            )
+            sc_elem = jnp.pad(
+                sc_elem, (0, padn), constant_values=np.float32(1.0)
+            )
+            sc_mine = lax.dynamic_slice(sc_elem, (d * L,), (L,))
+            acc = _hardround(
+                acc + _dev_dequant_int8(qt[r], sc_mine, z), z
+            )
+        if op == ReduceOp.AVG:
+            acc = _hardround(acc / jnp.float32(n), z)
+        # phase 2: re-encode the reduced shard (shard-local grid) and
+        # broadcast it encoded — every rank decodes identical bytes
+        shard_bounds = _grid_bounds(L, chunk_bytes)
+        q_shard, sc_shard = _quantize_chunks(acc, z, shard_bounds,
+                                             quant_impl)
+        qg = lax.all_gather(q_shard, axis)
+        sg = lax.all_gather(sc_shard, axis)
+        parts = [
+            _dev_dequant_int8(qg[r, s:e], sg[r, ci], z)
+            for r in range(n)
+            for ci, (s, e) in enumerate(shard_bounds)
+        ]
+        full = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        return full[:size]
+
+    def reduce_astype(x, z, size, L, padn, wd):
+        et = lax.all_to_all(
+            jnp.pad(x.astype(wd), (0, padn)).reshape(n, L), axis, 0, 0
+        )
+        acc = jnp.zeros((L,), jnp.float32)
+        for r in range(n):
+            acc = _hardround(acc + et[r].astype(jnp.float32), z)
+        if op == ReduceOp.AVG:
+            acc = _hardround(acc / jnp.float32(n), z)
+        g = lax.all_gather(acc.astype(wd), axis)
+        return g.astype(jnp.float32).reshape(-1)[:size]
+
+    def fn(z, *stacked):
+        def local(z, *rows):
+            d = lax.axis_index(axis)
+            outs = []
+            for row, (size, dt) in zip(rows, layouts):
+                x = row[0]
+                if size == 0:
+                    # every other path supports size-0 arrays; the
+                    # exchange has nothing to ship — emit the empty row
+                    outs.append(jnp.zeros((1, 0), np.dtype(dt)))
+                    continue
+                if np.dtype(dt) != np.float32:
+                    # uncompressed native reduce — the host codecs do
+                    # not compress these dtypes either
+                    red = lax.psum(x, axis)
+                    if op == ReduceOp.AVG:
+                        red = red / jnp.float32(n)
+                    outs.append(jnp.expand_dims(red, 0))
+                    continue
+                L = -(-size // n)
+                padn = n * L - size
+                if codec_name == "int8":
+                    out = reduce_int8(x, z, size, L, padn, d)
+                else:
+                    wd = {"bf16": jnp.bfloat16,
+                          "fp16": jnp.float16}[codec_name]
+                    out = reduce_astype(x, z, size, L, padn, wd)
+                outs.append(jnp.expand_dims(out, 0))
+            return tuple(outs)
+
+        mesh_mgr._note_trace()
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(),) + tuple(P(axis) for _ in stacked),
+            out_specs=tuple(P(axis) for _ in stacked),
+            check_rep=False,
+        )(z, *stacked)
+
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P(axis))
+    avals = [jax.ShapeDtypeStruct((), np.int32, sharding=rep)] + [
+        jax.ShapeDtypeStruct((n, size), np.dtype(dt), sharding=row)
+        for (size, dt) in layouts
+    ]
+    with _x64_trace():
+        return jax.jit(fn).lower(*avals).compile(), (rep, row)
+
+
+def _build_quantized_psum_scatter(mesh_mgr: MeshManager, world_size: int,
+                                  codec_name: str, chunk_bytes: int,
+                                  op: str, sizes: Sequence[int],
+                                  quant_impl: str = "xla"):
+    """Quantized reduce_scatter on the native path: phase 1 of
+    :func:`_build_quantized_psum` alone — each rank quantizes its
+    contribution to every destination array (per-chunk scales on each
+    array's slot grid), ``all_to_all`` ships the int8/bf16 payload to
+    its owner, and the owner dequantize-accumulates its own reduced
+    shard in f32. No broadcast phase: the sharded weight update
+    allgathers PARAMS after the optimizer step, not gradients. Input
+    layout matches :func:`_build_psum_scatter` ((world, world*L)
+    stacked f32, one slot per destination rank); cached per (world,
+    codec, chunk grid, op, sizes, quant impl)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = world_size
+    mesh = mesh_mgr.mesh_for(n)
+    axis = mesh_mgr.axis_name
+    L = max(sizes) if sizes else 1
+    bounds = _grid_bounds(L, chunk_bytes)
+    lens = np.array([e - s for s, e in bounds])
+
+    def fn(z, stacked):
+        def local(z, row):
+            x = row[0].reshape(n, L)
+            d = lax.axis_index(axis)
+            if codec_name == "int8":
+                q_rows, s_rows = [], []
+                for j in range(n):
+                    q_j, s_j = _quantize_chunks(x[j], z, bounds,
+                                                quant_impl)
+                    q_rows.append(q_j)
+                    s_rows.append(s_j)
+                qt = lax.all_to_all(jnp.stack(q_rows), axis, 0, 0)
+                sc_all = lax.all_gather(jnp.stack(s_rows), axis)
+                acc = jnp.zeros((L,), jnp.float32)
+                for r in range(n):
+                    sc_r = lax.dynamic_index_in_dim(
+                        sc_all[r], d, 0, keepdims=False
+                    )
+                    sc_elem = jnp.repeat(
+                        sc_r, jnp.asarray(lens), total_repeat_length=L
+                    )
+                    acc = _hardround(
+                        acc + _dev_dequant_int8(qt[r], sc_elem, z), z
+                    )
+            else:
+                wd = {"bf16": jnp.bfloat16,
+                      "fp16": jnp.float16}[codec_name]
+                et = lax.all_to_all(x.astype(wd), axis, 0, 0)
+                acc = jnp.zeros((L,), jnp.float32)
+                for r in range(n):
+                    acc = _hardround(acc + et[r].astype(jnp.float32), z)
+            if op == ReduceOp.AVG:
+                acc = _hardround(acc / jnp.float32(n), z)
+            return jnp.expand_dims(acc, 0)
+
+        mesh_mgr._note_trace()
+        return shard_map(
+            local, mesh=mesh, in_specs=(P(), P(axis)),
+            out_specs=P(axis), check_rep=False,
+        )(z, stacked)
+
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P(axis))
+    avals = [
+        jax.ShapeDtypeStruct((), np.int32, sharding=rep),
+        jax.ShapeDtypeStruct((n, n * L), np.float32, sharding=row),
+    ]
+    with _x64_trace():
+        return jax.jit(fn).lower(*avals).compile(), (rep, row)
+
+
 # ------------------------------------------------------ host-side fallback
 
 
@@ -497,7 +874,10 @@ def _host_allreduce(contribs: List[List[np.ndarray]], algorithm: str,
     """In-group host simulation of the transport's star/ring math for
     payload dtypes the device plane cannot hold (64-bit). Runs the REAL
     codec code over the real chunk grid, so it is bitwise-identical to
-    the socket transport by construction. Returns per-rank results."""
+    the socket transport by construction. ``algorithm="psum"`` payloads
+    map onto the ring simulation (psum has no host accumulation order
+    to reproduce — it is the numeric path either way). Returns per-rank
+    results."""
     n = len(contribs)
     codec = _CODECS[codec_name]()
     reduce_fn = _REDUCE_FNS.get(ReduceOp.SUM if op == ReduceOp.AVG else op)
@@ -888,6 +1268,15 @@ class _XlaGroup:
         codec_name = ctx0._codec_name
         chunk_bytes = ctx0._chunk_bytes
         arrays0 = ordered[0].arrays
+        # Op-dependent capability (the ctor vetted the static combo):
+        # e.g. int8 psum with op='max' — per-chunk scales cannot ride a
+        # max reduction. ONE definition (unsupported_reason) shared with
+        # Manager.comm_supports and the bench sweeps.
+        reason = XlaCommContext.unsupported_reason(
+            algorithm, codec_name, op
+        )
+        if reason is not None:
+            raise ValueError(reason)
         if op == ReduceOp.AVG and not all(
             _is_float(a.dtype) for a in arrays0
         ):
@@ -905,6 +1294,17 @@ class _XlaGroup:
         # cache key, zero extra compiles, trivially bitwise with the
         # replicated arm; the hardware-native path below
         # (_execute_psum_scatter) lowers to jax.lax.psum_scatter.
+        # Bytes-on-wire accounting (one direction, one rank's encoded
+        # contribution — the wire_nbytes definition): cumulative raw vs
+        # encoded counters in EVERY member's sink, so a quantized-psum
+        # run's compression ratio is a Δcounter division. Same keys as
+        # the host transport's.
+        raw_b = float(sum(a.nbytes for a in arrays0))
+        enc_b = float(sum(ctx0.wire_nbytes(a) for a in arrays0))
+        for r in range(n):
+            m = self._members[r].metrics
+            m.incr("comm_raw_bytes", raw_b)
+            m.incr("comm_encoded_bytes", enc_b)
         owners = (
             ordered[0].owners
             if ordered[0].opcode == "reduce_scatter" else None
@@ -948,15 +1348,27 @@ class _XlaGroup:
                 (int(arrays0[j].size), _dtype_key(arrays0[j].dtype))
                 for j in dev_idx
             )
-            key = (n, algorithm, codec_name, chunk_bytes, op, layouts)
             mm = self.mesh_mgr
-            compiled, (rep, row) = mm.executable(
-                key,
-                lambda: _build_allreduce(
+            if algorithm == "psum" and codec_name != "none":
+                # the quantized native exchange (EQuARX): encode →
+                # all_to_all/all_gather of encoded payloads → decode-
+                # accumulate, one executable cached per (world, codec,
+                # grid, op, layouts, quant impl) like every collective
+                quant_impl = _quant_impl()
+                key = (n, "psum_q", codec_name, chunk_bytes, op,
+                       layouts, quant_impl)
+                build = lambda: _build_quantized_psum(  # noqa: E731
+                    mm, n, codec_name, chunk_bytes, op,
+                    [(s, np.dtype(d)) for (s, d) in layouts],
+                    quant_impl,
+                )
+            else:
+                key = (n, algorithm, codec_name, chunk_bytes, op, layouts)
+                build = lambda: _build_allreduce(  # noqa: E731
                     mm, n, algorithm, codec_name, chunk_bytes, op,
                     [(s, np.dtype(d)) for (s, d) in layouts],
-                ),
-            )
+                )
+            compiled, (rep, row) = mm.executable(key, build)
             n_chunks = float(sum(
                 len(_chunk_grid([arrays0[j].reshape(-1)], chunk_bytes))
                 for j in dev_idx
@@ -1001,29 +1413,53 @@ class _XlaGroup:
         scatter hands device r the reduced slot r, which lands back in
         rank r's owned array. SUM/AVG only, f32 only, owners ==
         range(n) — the sharded-update layout; anything else runs the
-        parity path. Like algorithm='psum' allreduce, the reduction
-        order is XLA's to choose, so this path is outside the bitwise
-        A/B by construction."""
+        parity path. A lossy codec swaps in the QUANTIZED variant
+        (_build_quantized_psum_scatter: encoded all_to_all, owner-side
+        decode-accumulate) with zero call-site changes. Like
+        algorithm='psum' allreduce, the reduction order is XLA's to
+        choose, so this path is outside the bitwise A/B by
+        construction."""
         import jax
 
         n = self.world_size
+        ctx0 = self._members[0]
+        codec_name = ctx0._codec_name
+        chunk_bytes = ctx0._chunk_bytes
         arrays0 = ordered[0].arrays
         sizes = tuple(int(a.size) for a in arrays0)
         mm = self.mesh_mgr
-        key = (n, "psum_scatter", op, sizes)
-        compiled, row = mm.executable(
-            key, lambda: _build_psum_scatter(mm, n, op, sizes)
-        )
         L = max(sizes) if sizes else 0
         if L == 0:
             return
+        if codec_name != "none":
+            # quantized native reduce_scatter: phase 1 of the quantized
+            # psum alone — encoded all_to_all, owner-side decode-
+            # accumulate (the sharded weight update's gradient hop)
+            quant_impl = _quant_impl()
+            key = (n, "psum_scatter_q", codec_name, chunk_bytes, op,
+                   sizes, quant_impl)
+            compiled, (rep, row) = mm.executable(
+                key, lambda: _build_quantized_psum_scatter(
+                    mm, n, codec_name, chunk_bytes, op, sizes, quant_impl
+                )
+            )
+        else:
+            rep = None
+            key = (n, "psum_scatter", op, sizes)
+            compiled, row = mm.executable(
+                key, lambda: _build_psum_scatter(mm, n, op, sizes)
+            )
         stacked = np.zeros((n, n * L), np.float32)
         for r, sub in enumerate(ordered):
             for j, a in enumerate(sub.arrays):
                 stacked[r, j * L: j * L + sizes[j]] = (
                     np.ascontiguousarray(a).reshape(-1)
                 )
-        out = np.asarray(compiled(jax.device_put(stacked, row)))
+        with _x64_trace():
+            ins = [jax.device_put(stacked, row)]
+            if rep is not None:
+                ins.insert(0, jax.device_put(np.int32(0), rep))
+        out = np.asarray(compiled(*ins))
         for r, sub in enumerate(ordered):
             a = sub.arrays[r]
             np.copyto(a.reshape(-1), out[r, : sizes[r]])
@@ -1038,8 +1474,11 @@ class XlaCommContext(CommContext):
     ``algorithm``: "star"/"ring" reproduce the socket transport's
     accumulation order and codec bits exactly (the bitwise-oracle
     modes; "auto" picks ring at world_size >= 3 like the host), "psum"
-    lowers straight to ``jax.lax.psum`` — the hardware-native fast path
-    whose reduction order is XLA's to choose (codec must be "none").
+    is the hardware-native fast path whose reduction order is XLA's to
+    choose: codec "none" lowers straight to ``jax.lax.psum``; a lossy
+    codec runs the QUANTIZED exchange (_build_quantized_psum — encode
+    on the chunk grid, all_to_all/all_gather of encoded payloads,
+    decode-accumulate, one executable; sum/avg only).
 
     ``compression``/``chunk_bytes`` mirror TcpCommContext: same codecs,
     same chunk grid (also the int8 scale granularity), must match the
@@ -1059,20 +1498,9 @@ class XlaCommContext(CommContext):
         super().__init__()
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
-        if algorithm not in ("auto", "star", "ring", "psum"):
-            raise ValueError(f"unknown algorithm {algorithm!r}")
-        if compression not in _CODECS:
-            raise ValueError(
-                f"unknown compression {compression!r}; have "
-                f"{sorted(_CODECS)}"
-            )
-        if algorithm == "psum" and compression != "none":
-            raise ValueError(
-                "algorithm='psum' lowers to a raw jax.lax.psum and "
-                "cannot carry a wire codec; use 'star'/'ring' (the "
-                "fused encode-exchange-decode paths) with "
-                f"compression={compression!r}"
-            )
+        reason = self.unsupported_reason(algorithm, compression)
+        if reason is not None:
+            raise ValueError(reason)
         if chunk_bytes < 0:
             raise ValueError("chunk_bytes must be >= 0")
         self._timeout = float(timeout)
@@ -1089,6 +1517,38 @@ class XlaCommContext(CommContext):
         self.metrics = Metrics()
         self.metrics.label("comm_backend", self.backend_name)
         self._events = None  # flight recorder (set_events)
+
+    @classmethod
+    def unsupported_reason(cls, algorithm: str, compression: str,
+                           op: str = ReduceOp.SUM) -> Optional[str]:
+        """THE xla-plane capability rule (CommContext surface): every
+        codec runs on star/ring (the bitwise parity paths) for every
+        reduce op; the hardware-native ``psum`` path carries every codec
+        too (the quantized exchange — EQuARX) but a LOSSY codec only
+        accumulates: per-chunk scales cannot ride a max/min reduction,
+        so that combo gets a prescriptive error instead of silently
+        wrong extrema."""
+        if algorithm not in ("auto", "star", "ring", "psum"):
+            return f"unknown algorithm {algorithm!r}"
+        if compression not in _CODECS:
+            return (
+                f"unknown compression {compression!r}; have "
+                f"{sorted(_CODECS)}"
+            )
+        if (
+            algorithm == "psum"
+            and compression != "none"
+            and op not in (ReduceOp.SUM, ReduceOp.AVG)
+        ):
+            return (
+                f"algorithm='psum' with compression={compression!r} "
+                "runs the quantized exchange, which only ACCUMULATES "
+                f"(sum/avg) — block scales cannot ride op={op!r}. Use "
+                "compression='none' for max/min on the psum path, or "
+                "the star/ring parity paths (their fused codecs handle "
+                "every op)"
+            )
+        return None
 
     def set_metrics(self, metrics: Metrics) -> None:
         """Share the Manager's sink (same contract as TcpCommContext);
@@ -1204,19 +1664,22 @@ class XlaCommContext(CommContext):
             return self._generation
 
     def wire_compensable(self) -> bool:
-        """Same role-aware rule as the host transport: only a star
-        PEER's contribution crosses the (emulated) wire through the
-        lossy codec — the root's stays raw and ring partial sums ride
-        uncompressed (psum carries no codec at all)."""
+        """Role-aware like the host transport: a star PEER's
+        contribution crosses the (emulated) wire through the lossy
+        codec (the root's stays raw; ring partial sums ride
+        uncompressed) — and on the quantized ``psum`` path EVERY rank's
+        contribution is phase-1 encoded before the exchange, so every
+        rank is compensable. The EF residual is computed against the
+        host ``codec_roundtrip`` image, which the device phase-1 encode
+        bit-matches (same grid, same scale math — the convergence-
+        oracle discipline)."""
         with self._lock:
             world = self._world_size
             rank = self._rank
-        return (
-            self._codec_name != "none"
-            and world > 1
-            and self._resolved_algorithm(world) == "star"
-            and rank != 0
-        )
+        if self._codec_name == "none" or world <= 1:
+            return False
+        algo = self._resolved_algorithm(world)
+        return (algo == "star" and rank != 0) or algo == "psum"
 
     def wire_roundtrip(self, src: np.ndarray, out: np.ndarray) -> None:
         """The host codec IS the device codec bit for bit (pinned by

@@ -141,8 +141,10 @@ def _ef_gate(manager, error_feedback: "bool | str") -> bool:
     between them could silently diverge): enabled AND this rank's
     contribution actually crosses the wire through a lossy codec
     (``wire_compensable`` — role-aware: a star root or ring member's
-    contribution is never encoded) AND this replica contributes real
-    gradients this step (healing/spare replicas ship zeros —
+    contribution is never encoded, while on the quantized native psum
+    path EVERY rank's contribution is phase-1 encoded, so every rank
+    compensates) AND this replica contributes real gradients this step
+    (healing/spare replicas ship zeros —
     compensating those would bank the whole gradient as 'error').
     ``error_feedback=True`` forces the arena on (documented force
     semantics); pre-striping managers fall back to codec lossiness."""

@@ -1220,6 +1220,26 @@ class Manager:
             return int(fn(a))
         return int(np.asarray(a).nbytes)
 
+    def comm_unsupported_reason(
+        self, algorithm: str, compression: str, op: str = ReduceOp.SUM
+    ) -> Optional[str]:
+        """Capability query against the active data plane (ONE shared
+        definition per backend — CommContext.unsupported_reason): None
+        when the combo runs, else a prescriptive error string. Contexts
+        predating the surface support everything they construct with."""
+        fn = getattr(self._comm, "unsupported_reason", None)
+        if callable(fn):
+            return fn(algorithm, compression, op)
+        return None
+
+    def comm_supports(
+        self, algorithm: str, compression: str, op: str = ReduceOp.SUM
+    ) -> bool:
+        """True when the active data plane can run ``algorithm`` with
+        ``compression`` for ``op`` (e.g. quantized psum: xla yes for
+        sum/avg, host never)."""
+        return self.comm_unsupported_reason(algorithm, compression, op) is None
+
     def transport_world_size(self) -> int:
         """Members of the gradient wire for the current quorum (data-plane
         replicas: participants + healing receivers, minus observers).

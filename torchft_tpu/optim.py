@@ -172,6 +172,12 @@ class ShardedOptimizerWrapper:
     owned shards, the per-leaf update is the same jitted function, and
     the params allgather forwards raw bytes verbatim. The flag must
     match across replicas (it changes the collective sequence).
+    Exception: over an xla ``algorithm='psum'`` wire with a lossy codec
+    the gradient hop rides the QUANTIZED psum_scatter (encoded
+    all_to_all — comm/xla_backend.py) with zero changes here, and the
+    oracle is numeric (the quantization envelope), not bitwise; the
+    params allgather still moves raw bytes, so ranks agree bit-for-bit
+    with EACH OTHER (pinned by tests/test_quantized_psum.py).
 
     Constraints: ``tx`` must be an ELEMENTWISE optax transformation with
     value-independent init (sgd, momentum/nesterov, adam, adamw — the

@@ -137,6 +137,13 @@ class WireStubManager:
     def wire_nbytes(self, a) -> int:
         return self._ctx.wire_nbytes(a)
 
+    def comm_unsupported_reason(self, algorithm, compression,
+                                op=ReduceOp.SUM):
+        return self._ctx.unsupported_reason(algorithm, compression, op)
+
+    def comm_supports(self, algorithm, compression, op=ReduceOp.SUM) -> bool:
+        return self._ctx.supports(algorithm, compression, op)
+
     def transport_rank(self) -> int:
         rank = getattr(self._ctx, "rank", None)
         return int(rank()) if callable(rank) else 0
