@@ -74,7 +74,7 @@ def _sharded_update_phase() -> dict:
     from torchft_tpu.comm.store import StoreServer
     from torchft_tpu.comm.transport import TcpCommContext
     from torchft_tpu.optim import ShardedOptimizerWrapper
-    from torchft_tpu.utils.wire_stub import run_stub_ranks
+    from torchft_tpu.comm.wire_stub import run_stub_ranks
 
     world = int(os.environ.get("BENCH_SHARDED_WORLD", "2"))
     steps = int(os.environ.get("BENCH_SHARDED_STEPS", "4"))

@@ -1,0 +1,288 @@
+// torchft_tpu native control plane — sanitizer churn stress.
+//
+// Not a unit test: a concurrency battering ram, meant to run under
+// -fsanitize=thread (make -C native tsan). It drives the exact thread
+// shapes the Python suite creates transiently — parked quorum
+// long-polls being re-stamped, clients vanishing mid-park (the
+// dead-client MSG_PEEK path), heartbeat storms (single + batched),
+// domain reports racing status renders, join/abandon churn forcing
+// expiry and prune edges — for long enough, from enough threads, that
+// TSan sees every lock/state interleaving the handlers have. Any data
+// race fails the run (TSan's default exitcode 66); a clean exit prints
+// a counter summary and returns 0.
+//
+// Phase 1 hammers a bare IncrementalQuorum under its documented
+// usage contract (caller-held mutex) — heartbeat/join/decision/sweep/
+// install edges from racing threads.
+// Phase 2 stands up a root Lighthouse plus a tier-1 aggregator
+// reporting upstream, and storms both over real HTTP.
+//
+// Usage: churn_stress [phase_ms]   (default 2500 per phase; the TSan
+// build multiplies wall time ~5-10x, budget accordingly.)
+
+#include <atomic>
+#include <memory>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "httpx.h"
+#include "lighthouse.h"
+#include "quorum.h"
+
+using ftquorum::IncrementalQuorum;
+using ftquorum::Member;
+using ftquorum::QuorumOpts;
+
+namespace {
+
+std::atomic<bool> g_stop{false};
+std::atomic<uint64_t> g_quorum_ok{0};
+std::atomic<uint64_t> g_quorum_err{0};
+std::atomic<uint64_t> g_abandoned{0};
+std::atomic<uint64_t> g_heartbeats{0};
+std::atomic<uint64_t> g_status_polls{0};
+
+Member mk_member(const std::string& id, int64_t step) {
+  Member m;
+  m.replica_id = id;
+  m.address = "http://127.0.0.1:1";
+  m.store_address = "127.0.0.1:2";
+  m.step = step;
+  m.world_size = 1;
+  return m;
+}
+
+std::string quorum_body(const std::string& id, int64_t step) {
+  return "{\"requester\":" + mk_member(id, step).to_json().dump() + "}";
+}
+
+// ------------------------------------------------------------- phase 1
+
+void phase1_incremental_quorum(int64_t phase_ms) {
+  QuorumOpts opts;
+  opts.min_replicas = 2;
+  opts.join_timeout_ms = 50;
+  opts.heartbeat_timeout_ms = 40;
+  // Heap-allocate the phase-local state (like the C API does): a
+  // stack std::mutex is trivially destructible, so TSan never sees it
+  // die — when a later frame reuses the address, its lock bookkeeping
+  // carries over and every report after is cascade noise. delete goes
+  // through the sanitizer's interceptor, which resets the shadow.
+  auto iq_p = std::make_unique<IncrementalQuorum>(
+      opts, /*incremental=*/true, /*prune_after_ms=*/200);
+  auto mu_p = std::make_unique<std::mutex>();
+  IncrementalQuorum& iq = *iq_p;
+  std::mutex& mu = *mu_p;  // the lighthouse's mu_, in miniature
+  const int64_t t_end = fthttp::now_ms() + phase_ms;
+
+  auto heartbeater = [&](int tid) {
+    uint64_t n = 0;
+    while (!g_stop.load(std::memory_order_relaxed)) {
+      std::string id = "hb" + std::to_string(tid) + "-" +
+                       std::to_string(n++ % 7);
+      std::lock_guard<std::mutex> lk(mu);
+      iq.heartbeat(id, fthttp::now_ms());
+    }
+  };
+  auto joiner = [&] {
+    uint64_t n = 0;
+    while (!g_stop.load(std::memory_order_relaxed)) {
+      int64_t now = fthttp::now_ms();
+      std::string id = "hb0-" + std::to_string(n++ % 7);
+      std::lock_guard<std::mutex> lk(mu);
+      iq.heartbeat(id, now);
+      iq.join(now, mk_member(id, static_cast<int64_t>(n)));
+      const auto& d = iq.decision(now);
+      if (d.quorum.has_value()) iq.install(*d.quorum, now);
+    }
+  };
+  auto reader = [&] {
+    while (!g_stop.load(std::memory_order_relaxed)) {
+      int64_t now = fthttp::now_ms();
+      std::lock_guard<std::mutex> lk(mu);
+      iq.sweep(now);
+      (void)iq.decision(now);
+      (void)iq.healthy_count();
+      (void)iq.epoch();
+    }
+  };
+
+  std::vector<std::thread> ts;
+  ts.emplace_back(heartbeater, 0);
+  ts.emplace_back(heartbeater, 1);
+  ts.emplace_back(joiner);
+  ts.emplace_back(reader);
+  while (fthttp::now_ms() < t_end) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+  g_stop.store(true);
+  for (auto& t : ts) t.join();
+  g_stop.store(false);
+  std::printf("phase1: iq churn ok (epoch=%llu computes=%llu hits=%llu)\n",
+              (unsigned long long)iq.epoch(),
+              (unsigned long long)iq.compute_count(),
+              (unsigned long long)iq.cache_hits());
+}
+
+// ------------------------------------------------------------- phase 2
+
+void phase2_lighthouse_storm(int64_t phase_ms) {
+  ftlighthouse::LighthouseOpts ro;
+  ro.bind_host = "127.0.0.1";
+  ro.hostname = "127.0.0.1";
+  ro.quorum.min_replicas = 2;
+  ro.quorum.join_timeout_ms = 150;
+  ro.quorum.quorum_tick_ms = 10;
+  ro.quorum.heartbeat_timeout_ms = 120;
+  ro.prune_after_ms = 400;
+  auto root_p = std::make_unique<ftlighthouse::Lighthouse>(ro);
+  ftlighthouse::Lighthouse& root = *root_p;
+  root.start();
+
+  ftlighthouse::LighthouseOpts ao = ro;
+  ao.domain = "stress-domain";
+  ao.upstream_addr = "http://127.0.0.1:" + std::to_string(root.port());
+  ao.upstream_report_interval_ms = 25;
+  auto agg_p = std::make_unique<ftlighthouse::Lighthouse>(ao);
+  ftlighthouse::Lighthouse& agg = *agg_p;
+  agg.start();
+
+  const std::string host = "127.0.0.1";
+  const int rport = root.port();
+  const int aport = agg.port();
+  std::vector<std::thread> ts;
+
+  // Stable members long-polling for quorum on the root (they also
+  // exercise the parked-waiter re-stamp: heartbeat_timeout 120ms beats
+  // any park shorter than the RPC deadline only via re-stamping).
+  for (int i = 0; i < 3; i++) {
+    ts.emplace_back([&, i] {
+      uint64_t step = 0;
+      while (!g_stop.load(std::memory_order_relaxed)) {
+        auto r = fthttp::http_post(
+            host, rport, "/torchft.LighthouseService/Quorum",
+            quorum_body("stable-" + std::to_string(i),
+                        static_cast<int64_t>(step++)),
+            fthttp::now_ms() + 900);
+        (r.status == 200 ? g_quorum_ok : g_quorum_err)
+            .fetch_add(1, std::memory_order_relaxed);
+      }
+    });
+  }
+  // Churners: join under a fresh id each round, then walk away — the
+  // abandoned ids must expire and later be PRUNED while other handlers
+  // are mid-flight.
+  for (int i = 0; i < 2; i++) {
+    ts.emplace_back([&, i] {
+      uint64_t gen = 0;
+      while (!g_stop.load(std::memory_order_relaxed)) {
+        std::string id = "churn-" + std::to_string(i) + "-" +
+                         std::to_string(gen++);
+        auto r = fthttp::http_post(
+            host, rport, "/torchft.LighthouseService/Quorum",
+            quorum_body(id, 0), fthttp::now_ms() + 120);
+        (void)r;
+        g_abandoned.fetch_add(1, std::memory_order_relaxed);
+      }
+    });
+  }
+  // Dead-client path: a deadline so short the client hangs up while
+  // the handler is parked in cv_.wait — the handler's MSG_PEEK probe
+  // must notice and stop re-stamping (lighthouse.cc handle_quorum).
+  ts.emplace_back([&] {
+    while (!g_stop.load(std::memory_order_relaxed)) {
+      auto r = fthttp::http_post(
+          host, rport, "/torchft.LighthouseService/Quorum",
+          quorum_body("ghost", 0), fthttp::now_ms() + 40);
+      (void)r;
+      g_abandoned.fetch_add(1, std::memory_order_relaxed);
+    }
+  });
+  // Heartbeats: one single-id storm at the root, one batched storm at
+  // the aggregator (the domain fan-in path).
+  ts.emplace_back([&] {
+    uint64_t n = 0;
+    while (!g_stop.load(std::memory_order_relaxed)) {
+      auto r = fthttp::http_post(
+          host, rport, "/torchft.LighthouseService/Heartbeat",
+          "{\"replica_id\":\"hb-" + std::to_string(n++ % 5) + "\"}",
+          fthttp::now_ms() + 200);
+      if (r.status == 200) {
+        g_heartbeats.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+  });
+  ts.emplace_back([&] {
+    while (!g_stop.load(std::memory_order_relaxed)) {
+      auto r = fthttp::http_post(
+          host, aport, "/torchft.LighthouseService/Heartbeat",
+          "{\"replica_ids\":[\"b0\",\"b1\",\"b2\",\"b3\",\"b4\",\"b5\"]}",
+          fthttp::now_ms() + 200);
+      if (r.status == 200) {
+        g_heartbeats.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+  });
+  // A foreign aggregator's DomainReport racing the root's own tree
+  // bookkeeping + the status renders below.
+  ts.emplace_back([&] {
+    while (!g_stop.load(std::memory_order_relaxed)) {
+      auto r = fthttp::http_post(
+          host, rport, "/torchft.LighthouseService/DomainReport",
+          "{\"domain\":\"foreign\",\"tier\":1,\"healthy\":3,"
+          "\"participants\":2,\"quorum_id\":7,\"max_step\":11,"
+          "\"report_interval_ms\":25}",
+          fthttp::now_ms() + 200);
+      (void)r;
+      std::this_thread::sleep_for(std::chrono::milliseconds(5));
+    }
+  });
+  // Status pollers: the dashboard + machine surface render while every
+  // mutation above is in flight.
+  for (const char* path : {"/status.json", "/status"}) {
+    ts.emplace_back([&, path] {
+      while (!g_stop.load(std::memory_order_relaxed)) {
+        auto r = fthttp::http_get(host, rport, path,
+                                  fthttp::now_ms() + 200);
+        if (r.status == 200) {
+          g_status_polls.fetch_add(1, std::memory_order_relaxed);
+        }
+        auto r2 = fthttp::http_get(host, aport, path,
+                                   fthttp::now_ms() + 200);
+        (void)r2;
+      }
+    });
+  }
+
+  std::this_thread::sleep_for(std::chrono::milliseconds(phase_ms));
+  g_stop.store(true);
+  for (auto& t : ts) t.join();
+  agg.shutdown();
+  root.shutdown();
+  g_stop.store(false);
+  std::printf(
+      "phase2: lighthouse storm ok (quorum ok=%llu err=%llu "
+      "abandoned=%llu heartbeats=%llu status=%llu)\n",
+      (unsigned long long)g_quorum_ok.load(),
+      (unsigned long long)g_quorum_err.load(),
+      (unsigned long long)g_abandoned.load(),
+      (unsigned long long)g_heartbeats.load(),
+      (unsigned long long)g_status_polls.load());
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int64_t phase_ms = 2500;
+  if (argc > 1) phase_ms = std::atoll(argv[1]);
+  if (phase_ms <= 0) phase_ms = 2500;
+  phase1_incremental_quorum(phase_ms);
+  phase2_lighthouse_storm(phase_ms);
+  std::printf("churn_stress: clean\n");
+  return 0;
+}

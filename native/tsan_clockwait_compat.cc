@@ -1,0 +1,39 @@
+// Sanitizer-build-only shim (linked into churn_stress_*, NEVER into
+// libtorchft_tpu_native.so).
+//
+// GCC 10's libtsan has no interceptor for pthread_cond_clockwait, but
+// libstdc++ >= 9 uses it for every steady_clock condition_variable
+// wait (cv.wait_until/wait_for) — so under TSan the mutex release
+// inside the wait is invisible, the waiting thread appears to hold the
+// lock forever, and the first cv timeout poisons the run with phantom
+// "double lock of a mutex" reports and cascade races on state that is
+// actually lock-protected (observed on this exact tree; GCC 11 ships
+// the interceptor and makes this file unnecessary).
+//
+// The shim interposes the symbol from the main executable and routes
+// through pthread_cond_timedwait (which libtsan DOES intercept),
+// converting the caller's clock deadline to CLOCK_REALTIME. The
+// conversion tolerates wall-clock skew only to the extent the stress
+// tolerates it — fine for a bounded churn run, not something to link
+// into production code.
+
+#include <pthread.h>
+#include <time.h>
+
+extern "C" int pthread_cond_clockwait(pthread_cond_t* cond,
+                                      pthread_mutex_t* mutex,
+                                      clockid_t clock,
+                                      const struct timespec* abstime) {
+  struct timespec now_clock, now_real, conv;
+  clock_gettime(clock, &now_clock);
+  clock_gettime(CLOCK_REALTIME, &now_real);
+  long long delta_ns =
+      (abstime->tv_sec - now_clock.tv_sec) * 1000000000LL +
+      (abstime->tv_nsec - now_clock.tv_nsec);
+  if (delta_ns < 0) delta_ns = 0;
+  long long tgt =
+      now_real.tv_sec * 1000000000LL + now_real.tv_nsec + delta_ns;
+  conv.tv_sec = static_cast<time_t>(tgt / 1000000000LL);
+  conv.tv_nsec = static_cast<long>(tgt % 1000000000LL);
+  return pthread_cond_timedwait(cond, mutex, &conv);
+}

@@ -47,7 +47,7 @@ import jax.numpy as jnp  # noqa: E402
 
 from torchft_tpu.comm import StoreServer, TcpCommContext  # noqa: E402
 from torchft_tpu.local_sgd import DiLoCo  # noqa: E402
-from torchft_tpu.utils.wire_stub import WireStubManager  # noqa: E402
+from torchft_tpu.comm.wire_stub import WireStubManager  # noqa: E402
 
 # Shared with tests/test_localsgd_streaming.py and bench_smoke.py so
 # every harness drives the identical manager surface.

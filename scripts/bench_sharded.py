@@ -45,7 +45,7 @@ def run_arm(store, prefix, sharded, world, steps, params0, chunk_bytes):
     import jax.numpy as jnp
     from torchft_tpu.comm.transport import TcpCommContext
     from torchft_tpu.optim import ShardedOptimizerWrapper
-    from torchft_tpu.utils.wire_stub import run_stub_ranks
+    from torchft_tpu.comm.wire_stub import run_stub_ranks
 
     def _fn(mgr, rank):
         opt = ShardedOptimizerWrapper(

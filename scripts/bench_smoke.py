@@ -106,7 +106,7 @@ def diloco_smoke() -> "list[str]":
     from torchft_tpu.local_sgd import DiLoCo
     # The shared round-surface stub (also drives
     # tests/test_localsgd_streaming.py and scripts/bench_diloco.py).
-    from torchft_tpu.utils.wire_stub import WireStubManager as _Stub
+    from torchft_tpu.comm.wire_stub import WireStubManager as _Stub
 
     failures = []
     world, sync_every, fragments = 2, 4, 2
@@ -483,7 +483,7 @@ def sharded_smoke() -> "list[str]":
     from torchft_tpu.comm.store import StoreServer
     from torchft_tpu.comm.transport import TcpCommContext
     from torchft_tpu.optim import ShardedOptimizerWrapper
-    from torchft_tpu.utils.wire_stub import run_stub_ranks
+    from torchft_tpu.comm.wire_stub import run_stub_ranks
 
     failures: "list[str]" = []
     world = 2

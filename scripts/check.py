@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Run the invariant lint suite over the repo.
+
+    python scripts/check.py                # all static checkers
+    python scripts/check.py layering       # one checker
+    python scripts/check.py --list         # available checkers
+
+Exit status: 0 = clean, 1 = findings (printed one per line as
+``path:line: [checker] message``), 2 = usage error.
+
+This is the static half of the correctness-tooling plane; the dynamic
+half (the native TSan churn stress + the ``TORCHFT_TPU_LOCKCHECK=1``
+lock-order detector) runs via ``CHECK=1 scripts/test.sh`` — see
+docs/operations.md "Static analysis & sanitizers".
+
+Deliberately importable without jax or a built native lib: the analysis
+package touches only the stdlib.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_analysis():
+    """Load torchft_tpu/analysis as a standalone package — NOT through
+    `import torchft_tpu`, which would execute the entire runtime first.
+    That matters twice: a syntax error anywhere in the runtime must
+    come back as a `[parse]` FINDING, not kill the linter at import
+    time; and a bare CI venv (no jax/numpy) must still be able to run
+    the lints."""
+    pkg_dir = REPO / "torchft_tpu" / "analysis"
+    spec = importlib.util.spec_from_file_location(
+        "tt_analysis", pkg_dir / "__init__.py",
+        submodule_search_locations=[str(pkg_dir)],
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["tt_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_analysis = _load_analysis()
+CHECKERS = _analysis.CHECKERS
+format_findings = _analysis.format_findings
+run_all = _analysis.run_all
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("checkers", nargs="*",
+                    help=f"subset of {sorted(CHECKERS)} (default: all)")
+    ap.add_argument("--root", type=Path, default=REPO,
+                    help="tree to lint (default: this repo)")
+    ap.add_argument("--list", action="store_true", dest="list_checkers")
+    args = ap.parse_args(argv)
+    if args.list_checkers:
+        for name, scope in sorted(CHECKERS.items()):
+            print(f"{name}: scope={list(scope)}")
+        return 0
+    try:
+        findings = run_all(args.root, only=args.checkers or None)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if findings:
+        print(format_findings(findings))
+        print(f"\n{len(findings)} finding(s).", file=sys.stderr)
+        return 1
+    names = ", ".join(sorted(args.checkers or CHECKERS))
+    print(f"check.py: clean ({names})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

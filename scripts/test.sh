@@ -5,6 +5,13 @@
 #
 #   scripts/test.sh              # tier-1 gate (non-slow tests, CPU devices)
 #   FULL=1 scripts/test.sh       # native build + entire suite (slow included)
+#   CHECK=1 scripts/test.sh      # correctness-tooling gate: the static
+#                                # invariant lints (scripts/check.py) +
+#                                # the native churn stress under TSan
+#                                # (make -C native tsan) — fails on any
+#                                # lint finding or data race; see
+#                                # docs/operations.md "Static analysis
+#                                # & sanitizers"
 #   BENCH_SMOKE=1 scripts/test.sh  # one short bench.py window + one tiny
 #                                  # heal round + one streaming-DiLoCo round
 #                                  # + one xla allreduce round + one
@@ -25,11 +32,24 @@ if [ "${BENCH_SMOKE:-0}" = "1" ]; then
     exec python scripts/bench_smoke.py
 fi
 
+if [ "${CHECK:-0}" = "1" ]; then
+    set -ex
+    python scripts/check.py
+    make -C native tsan
+    exit 0
+fi
+
 if [ "${FULL:-0}" = "1" ]; then
     set -ex
     make -j -C native
     exec python -m pytest tests/ -q
 fi
+
+# Rebuild the native lib if its sources moved so tests never run
+# against a stale tracked-nowhere .so (artifacts left by an old
+# checkout). Quiet + incremental: a no-op when up to date; tolerated
+# to fail (control/_native.py builds on demand as the fallback).
+make -C native >/dev/null 2>&1 || true
 
 # T1_TIMEOUT: ROADMAP's 870s by default. The 10 heaviest tests (>=25s
 # each, ~775s combined on this 2-core box) are marked `slow` (pytest.ini)

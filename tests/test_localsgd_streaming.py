@@ -26,7 +26,7 @@ from torchft_tpu.comm.context import CompletedWork, Work
 from torchft_tpu.comm.wire import split_weighted
 from torchft_tpu.local_sgd import DiLoCo, LocalSGD, fragment_boundaries
 from torchft_tpu.utils.metrics import Metrics
-from torchft_tpu.utils.wire_stub import WireStubManager
+from torchft_tpu.comm.wire_stub import WireStubManager
 
 
 @pytest.fixture()

@@ -135,7 +135,7 @@ def test_capability_surface_one_definition() -> None:
     # the managed surface routes through Manager.comm_supports /
     # comm_unsupported_reason (WireStubManager mirrors that surface)
     from torchft_tpu.comm.context import ManagedCommContext
-    from torchft_tpu.utils.wire_stub import WireStubManager
+    from torchft_tpu.comm.wire_stub import WireStubManager
 
     mcc = ManagedCommContext(WireStubManager(
         XlaCommContext(algorithm="psum", compression="int8"), 2
@@ -335,7 +335,7 @@ def _descend(mesh_mgr, tag, codec, error_feedback, steps, targets,
     cycle time-averages out; raw quantization bias survives any
     averaging."""
     from torchft_tpu.ddp import DistributedDataParallel
-    from torchft_tpu.utils.wire_stub import WireStubManager
+    from torchft_tpu.comm.wire_stub import WireStubManager
 
     world = len(targets)
     ctxs = _qpsum_ctxs(mesh_mgr, world, codec, chunk_bytes=chunk_bytes)
@@ -473,7 +473,7 @@ def test_sharded_update_over_quantized_psum_scatter(mesh_mgr) -> None:
     import jax
     import jax.numpy as jnp
     from torchft_tpu.optim import ShardedOptimizerWrapper
-    from torchft_tpu.utils.wire_stub import WireStubManager
+    from torchft_tpu.comm.wire_stub import WireStubManager
 
     world = 2
     rng = np.random.default_rng(0)

@@ -24,7 +24,7 @@ from torchft_tpu.comm.context import (
     ManagedCommContext,
 )
 from torchft_tpu.ddp import ShardedGradReducer, shard_ranges
-from torchft_tpu.utils.wire_stub import WireStubManager
+from torchft_tpu.comm.wire_stub import WireStubManager
 
 TIMEOUT = 30.0
 

@@ -261,7 +261,7 @@ def test_ddp_step_parity_int8_ef(store, mesh_mgr) -> None:
     # scaling) over both backends: the per-step averaged trees must be
     # bitwise identical — int8+EF is the satellite's hardest case.
     from torchft_tpu.ddp import DistributedDataParallel
-    from torchft_tpu.utils.wire_stub import WireStubManager
+    from torchft_tpu.comm.wire_stub import WireStubManager
 
     world, steps = 2, 3
     rng = np.random.default_rng(5)
@@ -327,7 +327,7 @@ def test_diloco_outer_round_parity_int8(store, mesh_mgr) -> None:
 
     import jax.numpy as jnp
     from torchft_tpu.local_sgd import DiLoCo
-    from torchft_tpu.utils.wire_stub import WireStubManager
+    from torchft_tpu.comm.wire_stub import WireStubManager
 
     world, sync_every, fragments = 2, 4, 2
 

@@ -13,6 +13,16 @@ Public API parity target: ref torchft/__init__.py:7-20.
 
 __version__ = "0.1.0"
 
+# Lock-order detector opt-in (TORCHFT_TPU_LOCKCHECK=1): must install
+# BEFORE the submodule imports below run, so module-level locks (e.g.
+# ddp's pipeline-executor lock) are created instrumented too. When
+# unset this is a no-op; the AST checker siblings stay unimported
+# (analysis/__init__ loads them lazily inside run_all).
+from torchft_tpu.analysis.lockcheck import maybe_install as _lockcheck_install
+
+_lockcheck_install()
+del _lockcheck_install
+
 from torchft_tpu.checkpoint_io import (  # noqa: F401
     AsyncCheckpointWriter,
     OrbaxCheckpointer,

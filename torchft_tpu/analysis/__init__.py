@@ -1,0 +1,93 @@
+"""Invariant lint suite: AST checkers for the repo's own contracts.
+
+Five checkers (see each module's docstring for the contract it
+enforces):
+
+* ``donation``        — no use-after-donate of collective inputs
+* ``one_definition``  — blessed contract functions defined exactly once
+* ``name_registry``   — metric/event names ↔ docs/operations.md §6
+* ``layering``        — the package import DAG
+* ``lockcheck``       — runtime lock acquisition-order cycles
+                        (``TORCHFT_TPU_LOCKCHECK=1``; not an AST pass)
+
+``scripts/check.py`` runs the four static checkers over the real tree;
+``scripts/test.sh CHECK=1`` adds the native TSan churn stress. This
+package imports NOTHING from the torchft_tpu runtime — the layering
+checker enforces that on the package itself — so the linters stay
+loadable in a bare CI venv with no jax installed.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+# Checker submodules are imported lazily (inside run_all / on attribute
+# access): the package root imports this package on EVERY runtime
+# `import torchft_tpu` just to reach lockcheck.maybe_install, and must
+# not pay for the AST machinery it will never use.
+from .base import Finding, Source, format_findings, iter_sources
+
+__all__ = [
+    "Finding",
+    "Source",
+    "iter_sources",
+    "format_findings",
+    "run_all",
+    "CHECKERS",
+]
+
+# checker name -> scope (subpaths under the repo root it lints)
+CHECKERS: Dict[str, Sequence[str]] = {
+    "donation": ("torchft_tpu", "scripts", "bench.py"),
+    "one-definition": ("torchft_tpu", "scripts", "bench.py"),
+    "name-registry": ("torchft_tpu",),
+    "layering": ("torchft_tpu",),
+}
+
+
+def run_all(
+    root: Path, only: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Run the static checkers over the tree at ``root``."""
+    selected = set(only or CHECKERS)
+    unknown = selected - set(CHECKERS)
+    if unknown:
+        raise ValueError(
+            f"unknown checkers {sorted(unknown)}; "
+            f"available: {sorted(CHECKERS)}"
+        )
+    cache: Dict[Sequence[str], List[Source]] = {}
+
+    def sources(scope: Sequence[str]) -> List[Source]:
+        if scope not in cache:
+            cache[scope] = iter_sources(root, scope)
+        return cache[scope]
+
+    from . import donation, layering, name_registry, one_definition
+
+    findings: List[Finding] = []
+    # parse errors anywhere in scope are findings (a checker that
+    # silently skips unparsable files is a checker that can be dodged)
+    seen: set = set()
+    for name in sorted(selected):
+        for src in sources(CHECKERS[name]):
+            if src.tree is None and src.parse_error and src.rel not in seen:
+                seen.add(src.rel)
+                findings.append(Finding(
+                    "parse", src.rel, src.parse_error.lineno or 1,
+                    f"syntax error: {src.parse_error.msg}",
+                ))
+    if "donation" in selected:
+        findings.extend(donation.check(sources(CHECKERS["donation"])))
+    if "one-definition" in selected:
+        findings.extend(
+            one_definition.check(sources(CHECKERS["one-definition"]))
+        )
+    if "name-registry" in selected:
+        findings.extend(name_registry.check(
+            sources(CHECKERS["name-registry"]), root=root
+        ))
+    if "layering" in selected:
+        findings.extend(layering.check(sources(CHECKERS["layering"])))
+    return findings

@@ -1,0 +1,112 @@
+"""Layering lint: the package's import DAG, machine-enforced.
+
+The repo's layers, bottom-up: ``utils`` (leaf helpers — metrics,
+events, net, profiling, serialization) sit under everything and import
+NOTHING else from the package; ``futures`` and ``comm`` form the data
+plane; ``control`` is the native control-plane binding (utils only);
+``ops``/``parallel``/``models`` are the model zoo. The orchestration
+layer (manager, ddp, optim, local_sgd, checkpointing, ...) may import
+any of them — but never the reverse: ``comm/`` importing ``manager``
+would recreate the circular manager↔transport coupling the reference
+suffers from, and ``utils/`` importing ``comm/`` makes the leaf layer
+unloadable without the data plane (exactly the drift this checker
+caught on its first run: utils/wire_stub.py, since moved to comm/).
+
+Modules listed in :data:`ALLOWED` may import (within torchft_tpu) only
+the named layers; modules not listed are unconstrained. All imports
+count, including function-scoped lazy ones — a lazy import is still a
+layering edge, just a slower one.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from .base import Finding, Source
+
+__all__ = ["check", "ALLOWED"]
+
+CHECKER = "layering"
+
+PACKAGE = "torchft_tpu"
+
+# layer (first path segment under torchft_tpu/, or the module name for
+# top-level modules) -> layers it may import from the package.
+ALLOWED: Dict[str, Set[str]] = {
+    "utils": {"utils"},
+    "futures": {"futures", "utils"},
+    "comm": {"comm", "utils", "futures"},
+    "control": {"control", "utils"},
+    "analysis": {"analysis"},
+    "ops": {"ops", "utils"},
+    "parallel": {"parallel", "ops", "comm", "futures", "utils"},
+    "models": {"models", "ops", "parallel", "utils"},
+}
+
+
+def _layer_of(rel: str) -> Optional[str]:
+    """torchft_tpu/comm/transport.py -> 'comm';
+    torchft_tpu/manager.py -> 'manager'; non-package files -> None."""
+    parts = rel.split("/")
+    if parts[0] != PACKAGE or len(parts) < 2:
+        return None
+    if len(parts) == 2:
+        name = parts[1]
+        if name == "__init__.py":
+            return None  # the root facade re-exports everything
+        return name[:-3] if name.endswith(".py") else name
+    return parts[1]
+
+
+def _module_of_import(node: ast.AST, rel: str) -> List[str]:
+    """Fully-qualified torchft_tpu module names imported by this node."""
+    mods: List[str] = []
+    if isinstance(node, ast.Import):
+        mods = [a.name for a in node.names]
+    elif isinstance(node, ast.ImportFrom):
+        if node.level == 0:
+            mods = [node.module or ""]
+        else:
+            # resolve relative: the containing package, then up
+            # `level-1` more packages (level=1 = the package itself —
+            # for __init__.py that package is the module's own dir)
+            pkg = rel[:-3].split("/")[:-1]  # drop file (+ __init__)
+            base = pkg[: len(pkg) - (node.level - 1)]
+            mod = ".".join(base + ([node.module] if node.module else []))
+            mods = [mod]
+    return [m for m in mods if m == PACKAGE or m.startswith(PACKAGE + ".")]
+
+
+def _imported_layer(mod: str) -> str:
+    segs = mod.split(".")
+    return segs[1] if len(segs) > 1 else ""
+
+
+def check(sources: Sequence[Source]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in sources:
+        layer = _layer_of(src.rel)
+        if layer is None or layer not in ALLOWED:
+            continue
+        tree = src.tree
+        if tree is None:
+            continue
+        allowed = ALLOWED[layer]
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            for mod in _module_of_import(node, src.rel):
+                target = _imported_layer(mod)
+                if target == "":
+                    # `import torchft_tpu` / `from torchft_tpu import X`
+                    # pulls the root facade (and thus every layer)
+                    target = "<root facade>"
+                if target not in allowed:
+                    findings.append(Finding(
+                        CHECKER, src.rel, node.lineno,
+                        f"layer {layer!r} imports {mod!r} ({target}); "
+                        f"allowed layers for {layer!r}: "
+                        + ", ".join(sorted(allowed)),
+                    ))
+    return findings

@@ -24,12 +24,38 @@ _lib_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
 
+def _lib_cc_sources() -> "Optional[set]":
+    """The .cc files that are actually inputs to the .so, read from the
+    Makefile's SRCS line (the single source of truth). Sanitizer-plane
+    sources (churn_stress.cc, the tsan compat shim) are NOT in SRCS:
+    `make` never relinks the lib for them, so counting them in the
+    staleness scan would make _needs_build() permanently true — a
+    no-op make on every import, and a hard build failure on
+    toolchain-less machines with a perfectly good prebuilt .so.
+    Returns None (scan every .cc) if the Makefile cannot be parsed."""
+    try:
+        with open(os.path.join(_NATIVE_DIR, "Makefile")) as f:
+            text = f.read()
+    except OSError:
+        return None
+    import re
+
+    m = re.search(r"^SRCS\s*=\s*(.+)$", text, re.MULTILINE)
+    if not m:
+        return None
+    return set(m.group(1).split())
+
+
 def _needs_build() -> bool:
     if not os.path.exists(_LIB_PATH):
         return True
     lib_mtime = os.path.getmtime(_LIB_PATH)
+    lib_srcs = _lib_cc_sources()
     for name in os.listdir(_NATIVE_DIR):
-        if name.endswith((".cc", ".h")):
+        is_input = name.endswith(".h") or (
+            name.endswith(".cc") and (lib_srcs is None or name in lib_srcs)
+        )
+        if is_input:
             if os.path.getmtime(os.path.join(_NATIVE_DIR, name)) > lib_mtime:
                 return True
     return False

@@ -658,25 +658,15 @@ class LocalSGD:
             off += n
 
     def _ef_enabled(self) -> bool:
-        """Mirror of the DDP arena's gate: enabled AND this rank's
-        contribution actually crosses a lossy wire (role-aware) AND this
-        replica ships real values this round (healing/spare replicas
-        ship zeros — banking those as 'error' would replay the whole
-        value later)."""
-        if self._error_feedback is False:
-            return False
-        mgr = self._manager
-        if self._error_feedback == "auto":
-            compensable = getattr(mgr, "wire_compensable", None)
-            if callable(compensable):
-                if not compensable():
-                    return False
-            else:
-                lossy = getattr(mgr, "wire_is_lossy", None)
-                if not callable(lossy) or not lossy():
-                    return False
-        is_part = getattr(mgr, "is_participating", None)
-        return (not callable(is_part)) or bool(is_part())
+        """THE DDP error-feedback gate, applied to the outer stream:
+        enabled AND this rank's contribution actually crosses a lossy
+        wire (role-aware) AND this replica ships real values this round.
+        Delegates to ddp._ef_gate — this used to be a hand-rolled
+        mirror, which is exactly the drift the one-definition lint now
+        forbids (scripts/check.py)."""
+        from torchft_tpu.ddp import _ef_gate
+
+        return _ef_gate(self._manager, self._error_feedback)
 
     def _ef_prepare(self) -> None:
         """(Re)allocate zeroed residuals on first use and on every
