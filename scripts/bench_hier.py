@@ -1,0 +1,456 @@
+#!/usr/bin/env python
+"""bench_hier: rep-interleaved flat-vs-hier allreduce A/B over the host
+data plane with simulated per-tier latency injection (ISSUE 13).
+
+The oracle is COUNTER-SHAPED, per the r06-r13 lesson that wall-clock
+A/Bs null on the 2-core sandbox while bytes/hops/compile counters land
+honestly:
+
+* **bitwise phase** (codec=none): every rep of both arms is sha256'd
+  against its deterministic reference — the flat star accumulation and
+  THE hierarchical reference composition
+  (``xla_backend._host_hier_allreduce``: reduce-within in rank order →
+  star fan-in across domains → AVG divide). One mismatch fails the run.
+* **counter phase** (int8 cross-tier): Δ``comm_inter_bytes`` summed
+  over ranks (hier arm — egress ranks only, encoded) must be
+  <= ``--ratio-max`` (default 0.3) of the flat arm's
+  Δ``comm_encoded_bytes`` (every rank, encoded). At 4 domains x 4
+  groups int8 the structural value is 0.25: 4 egress contributions vs
+  16.
+* **hop phase**: ``comm_hops``/op swept across world sizes at FIXED
+  domain count — the hier arms (star inter, multi-hop ring inter) must
+  be FLAT in world size while the flat ring baseline grows 2(w-1).
+* **convergence phase**: the PR 2 toy quadratic through DDP over the
+  hier int8 inter tier — int8+EF must track the fp32 arm while raw
+  int8 parks (the EF-over-hier discipline).
+
+Wall clock is measured with per-tier latency injection (``--inter-ms``
+on every cross-DCN op, ``--intra-ms`` on intra-domain ops — the
+``bench_fleet``-style simulation; the flat arm's every op is a DCN op)
+AND without injection; the uninjected delta is expected to be an honest
+null here (loopback memcpy has no tiers) and is reported as such.
+
+    python scripts/bench_hier.py --domains 4 --groups 4 --mb 4 \
+        --reps 3 --out docs/evidence/bench_hier_ab_r15_run1.json
+
+Exit is non-zero on any oracle violation — treat a red bench_hier like
+a red test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import hashlib
+import json
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from torchft_tpu.comm.store import StoreServer  # noqa: E402
+from torchft_tpu.comm.topology import DomainTopology  # noqa: E402
+from torchft_tpu.comm.transport import TcpCommContext  # noqa: E402
+from torchft_tpu.comm.xla_backend import _host_hier_allreduce  # noqa: E402
+
+CHUNK = 1 << 20
+
+
+def _sha(a: np.ndarray) -> str:
+    return hashlib.sha256(np.ascontiguousarray(a).tobytes()).hexdigest()
+
+
+def _domain_map(domains: int, groups: int) -> Dict[str, List[str]]:
+    return {
+        f"dom{d}": [f"rank{d * groups + g}" for g in range(groups)]
+        for d in range(domains)
+    }
+
+
+def _groups_tuple(domains: int, groups: int):
+    return tuple(
+        tuple(range(d * groups, (d + 1) * groups)) for d in range(domains)
+    )
+
+
+def _mk_ctxs(world: int, *, topology: str, compression: str,
+             algorithm: str, static_map, timeout: float = 60.0):
+    return [
+        TcpCommContext(
+            timeout=timeout, algorithm=algorithm, channels=2,
+            compression=compression, chunk_bytes=CHUNK,
+            topology=topology,
+            domain_resolver=(
+                DomainTopology(static_map=static_map)
+                if topology == "hier" else None
+            ),
+        )
+        for _ in range(world)
+    ]
+
+
+def _inject(ctxs, *, flat_ms: float, intra_ms: float,
+            inter_ms: float) -> None:
+    """Per-tier latency injection via the transport's documented
+    ``_op_delay`` test hook: the flat arm's every op crosses DCN; the
+    hier arm pays ``intra_ms`` on intra-tier ops and ``inter_ms`` only
+    on the egress exchange."""
+    for ctx in ctxs:
+        ctx._op_delay = flat_ms / 1e3
+        h = ctx._hier
+        if h is not None:
+            if h.intra is not None:
+                h.intra._op_delay = intra_ms / 1e3
+            if h.inter is not None:
+                h.inter._op_delay = inter_ms / 1e3
+
+
+def _run_arm(ctxs, store_addr: str, tag: str, srcs, op: str = "sum",
+             reps: int = 1, inject: Optional[dict] = None):
+    """Configure a cohort, run ``reps`` allreduces, return per-rank
+    (last result, metrics snapshot, wall seconds list)."""
+    world = len(ctxs)
+    out = [None] * world
+
+    def _worker(rank):
+        ctx = ctxs[rank]
+        ctx.configure(f"{store_addr}/{tag}", rank, world)
+        return rank
+
+    with ThreadPoolExecutor(max_workers=world) as pool:
+        for f in [pool.submit(_worker, r) for r in range(world)]:
+            f.result(timeout=120)
+    if inject is not None:
+        _inject(ctxs, **inject)
+
+    def _round(rank):
+        ctx = ctxs[rank]
+        walls = []
+        data = None
+        for _ in range(reps):
+            data = srcs[rank].copy()
+            t0 = time.perf_counter()
+            ctx.allreduce([data], op).future().result(timeout=120)
+            walls.append(time.perf_counter() - t0)
+        return data, ctx.metrics.snapshot(), walls
+
+    gc.collect()
+    gc.disable()
+    try:
+        with ThreadPoolExecutor(max_workers=world) as pool:
+            futs = [pool.submit(_round, r) for r in range(world)]
+            for r, f in enumerate(futs):
+                out[r] = f.result(timeout=600)
+    finally:
+        gc.enable()
+    return out
+
+
+def bitwise_phase(args, failures: List[str]) -> dict:
+    """codec=none: both arms sha256'd vs deterministic references,
+    EVERY rep, rep-interleaved (fresh cohorts per rep pair)."""
+    world = args.domains * args.groups
+    smap = _domain_map(args.domains, args.groups)
+    gtuple = _groups_tuple(args.domains, args.groups)
+    rng = np.random.default_rng(15)
+    n = (args.mb * (1 << 20)) // 4
+    srcs = [rng.standard_normal(n).astype(np.float32)
+            for _ in range(world)]
+    flat_ref = srcs[0].copy()
+    for s in srcs[1:]:
+        flat_ref = flat_ref + s
+    hier_ref = _host_hier_allreduce(
+        [[s.copy()] for s in srcs], "none", CHUNK, "sum", gtuple, world
+    )[0]
+    flat_sha, hier_sha = _sha(flat_ref), _sha(hier_ref)
+    reps = []
+    for rep in range(args.reps):
+        for arm in ("flat", "hier"):
+            store = StoreServer()
+            ctxs = _mk_ctxs(
+                world, topology=arm, compression="none",
+                algorithm="star", static_map=smap,
+            )
+            try:
+                out = _run_arm(ctxs, store.addr, f"bw_{arm}_{rep}", srcs)
+                ref_sha = flat_sha if arm == "flat" else hier_sha
+                ok = all(_sha(o[0]) == ref_sha for o in out)
+                reps.append({"rep": rep, "arm": arm, "bitwise": ok})
+                if not ok:
+                    failures.append(
+                        f"bitwise phase: {arm} rep {rep} diverged from "
+                        "its deterministic reference"
+                    )
+            finally:
+                for c in ctxs:
+                    c.shutdown()
+                store.shutdown()
+    return {"flat_sha": flat_sha, "hier_sha": hier_sha, "reps": reps}
+
+
+def counter_phase(args, failures: List[str]) -> dict:
+    """int8 cross-tier: rep-interleaved flat-int8 vs hier-int8; the
+    graded oracle is Σranks(Δcomm_inter_bytes) <= ratio_max *
+    Σranks(Δcomm_encoded_bytes of the flat arm), plus injected and
+    uninjected wall clocks."""
+    world = args.domains * args.groups
+    smap = _domain_map(args.domains, args.groups)
+    rng = np.random.default_rng(16)
+    n = (args.mb * (1 << 20)) // 4
+    srcs = [rng.standard_normal(n).astype(np.float32)
+            for _ in range(world)]
+    raw_total = float(world * srcs[0].nbytes)
+    reps = []
+    for rep in range(args.reps):
+        row = {"rep": rep}
+        for arm in ("flat", "hier"):
+            for injected in (False, True):
+                store = StoreServer()
+                ctxs = _mk_ctxs(
+                    world, topology=arm, compression="int8",
+                    algorithm="star", static_map=smap,
+                )
+                try:
+                    inj = None
+                    if injected:
+                        inj = dict(
+                            flat_ms=(
+                                args.inter_ms if arm == "flat" else 0.0
+                            ),
+                            intra_ms=args.intra_ms,
+                            inter_ms=args.inter_ms,
+                        )
+                    out = _run_arm(
+                        ctxs, store.addr,
+                        f"ctr_{arm}_{rep}_{int(injected)}",
+                        srcs, reps=1, inject=inj,
+                    )
+                    key = f"{arm}_{'inj' if injected else 'raw'}"
+                    walls = [w for o in out for w in o[2]]
+                    row[f"{key}_wall_s"] = max(walls)
+                    if not injected:
+                        snaps = [o[1] for o in out]
+                        if arm == "flat":
+                            row["flat_encoded_bytes"] = sum(
+                                s.get("comm_encoded_bytes", 0.0)
+                                for s in snaps
+                            )
+                            row["flat_raw_bytes"] = sum(
+                                s.get("comm_raw_bytes", 0.0)
+                                for s in snaps
+                            )
+                        else:
+                            row["hier_inter_bytes"] = sum(
+                                s.get("comm_inter_bytes", 0.0)
+                                for s in snaps
+                            )
+                            row["hier_intra_bytes"] = sum(
+                                s.get("comm_intra_bytes", 0.0)
+                                for s in snaps
+                            )
+                            hops = {
+                                s.get("comm_hops") for s in snaps
+                            }
+                            row["hier_hops_per_rank"] = sorted(
+                                h for h in hops if h is not None
+                            )
+                finally:
+                    for c in ctxs:
+                        c.shutdown()
+                    store.shutdown()
+        row["inter_over_flat_encoded"] = (
+            row["hier_inter_bytes"] / row["flat_encoded_bytes"]
+            if row.get("flat_encoded_bytes") else None
+        )
+        ratio = row["inter_over_flat_encoded"]
+        if ratio is None or ratio > args.ratio_max:
+            failures.append(
+                f"counter phase rep {rep}: hier inter bytes / flat "
+                f"int8 wire bytes = {ratio} > {args.ratio_max}"
+            )
+        reps.append(row)
+    return {
+        "world": world, "domains": args.domains,
+        "payload_raw_bytes_total": raw_total, "reps": reps,
+    }
+
+
+def hop_phase(args, failures: List[str]) -> dict:
+    """comm_hops swept across world sizes at FIXED domain count: the
+    hier arms must be flat in world; the flat ring baseline is
+    2(w-1). Tiny payloads — this phase measures structure, not bytes."""
+    rows = []
+    n = 4096
+    for groups in args.hop_groups:
+        world = args.domains * groups
+        smap = _domain_map(args.domains, groups)
+        rng = np.random.default_rng(17)
+        srcs = [rng.standard_normal(n).astype(np.float32)
+                for _ in range(world)]
+        row = {"world": world, "domains": args.domains,
+               "flat_ring_hops": 2 * (world - 1)}
+        for arm, algo in (("hier_star", "star"), ("hier_ring", "ring")):
+            store = StoreServer()
+            ctxs = _mk_ctxs(
+                world, topology="hier", compression="int8",
+                algorithm=algo, static_map=smap,
+            )
+            try:
+                out = _run_arm(
+                    ctxs, store.addr, f"hop_{arm}_{world}", srcs
+                )
+                hops = {o[1].get("comm_hops") for o in out}
+                if len(hops) != 1:
+                    failures.append(
+                        f"hop phase {arm}@{world}: ranks disagree on "
+                        f"hops {sorted(hops)}"
+                    )
+                row[f"{arm}_hops"] = sorted(hops)[0]
+                ident = len({_sha(o[0]) for o in out}) == 1
+                if not ident:
+                    failures.append(
+                        f"hop phase {arm}@{world}: ranks decoded "
+                        "divergent values"
+                    )
+            finally:
+                for c in ctxs:
+                    c.shutdown()
+                store.shutdown()
+        rows.append(row)
+    # the graded shape: hier hops constant across worlds, flat grows
+    for key in ("hier_star_hops", "hier_ring_hops"):
+        vals = {r[key] for r in rows}
+        if len(vals) != 1:
+            failures.append(
+                f"hop phase: {key} varies with world size: "
+                f"{[(r['world'], r[key]) for r in rows]}"
+            )
+    flats = [r["flat_ring_hops"] for r in rows]
+    if not all(b > a for a, b in zip(flats, flats[1:])):
+        failures.append("hop phase: flat ring baseline failed to grow")
+    return {"rows": rows}
+
+
+def convergence_phase(args, failures: List[str]) -> dict:
+    """int8+EF over the hier inter tier tracks fp32 on the toy
+    quadratic; raw int8 parks (the convergence-oracle discipline)."""
+    from torchft_tpu.comm.wire_stub import WireStubManager
+    from torchft_tpu.ddp import DistributedDataParallel
+
+    world = 4
+    smap = {f"d{r}": [f"rank{r}"] for r in range(world)}
+    rng = np.random.default_rng(23)
+    targets = []
+    for _ in range(world):
+        t = rng.standard_normal(48).astype(np.float32)
+        t[:4] *= 100.0
+        targets.append(t)
+    optimum = np.mean(targets, axis=0).astype(np.float32)
+    scale = float(np.abs(optimum).max())
+    steps, tail = 200, 40
+
+    def descend(tag, codec, ef):
+        store = StoreServer()
+        ctxs = [
+            TcpCommContext(
+                timeout=30.0, algorithm="star", channels=2,
+                compression=codec, chunk_bytes=64, topology="hier",
+                domain_resolver=DomainTopology(static_map=smap),
+            )
+            for _ in range(world)
+        ]
+
+        def body(rank):
+            ctx = ctxs[rank]
+            ctx.configure(f"{store.addr}/{tag}", rank, world)
+            mgr = WireStubManager(ctx, world)
+            ddp = DistributedDataParallel(mgr, error_feedback=ef)
+            x = np.zeros_like(targets[rank])
+            acc = np.zeros(x.shape, np.float64)
+            for t in range(steps):
+                avg = ddp.average_gradients({"x": x - targets[rank]})
+                x = x - 0.2 * np.asarray(avg["x"])
+                if t >= steps - tail:
+                    acc += x
+            return (acc / tail).astype(np.float32)
+
+        try:
+            with ThreadPoolExecutor(max_workers=world) as pool:
+                return [
+                    f.result(timeout=300)
+                    for f in [pool.submit(body, r) for r in range(world)]
+                ][0]
+        finally:
+            for c in ctxs:
+                c.shutdown()
+            store.shutdown()
+
+    x_fp32 = descend("cv_fp32", "none", "auto")
+    x_raw = descend("cv_raw", "int8", False)
+    x_ef = descend("cv_ef", "int8", "auto")
+    err = {
+        "fp32": float(np.max(np.abs(x_fp32 - optimum))),
+        "raw_int8": float(np.max(np.abs(x_raw - optimum))),
+        "int8_ef": float(np.max(np.abs(x_ef - optimum))),
+        "ef_vs_fp32": float(np.max(np.abs(x_ef - x_fp32))),
+        "scale": scale,
+    }
+    if err["ef_vs_fp32"] > 1e-3 * scale:
+        failures.append(
+            f"convergence phase: int8+EF did not track fp32 ({err})"
+        )
+    if err["raw_int8"] < 10 * err["int8_ef"]:
+        failures.append(
+            f"convergence phase: raw int8 unexpectedly matched EF ({err})"
+        )
+    return err
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--domains", type=int, default=4)
+    ap.add_argument("--groups", type=int, default=4,
+                    help="replica groups per domain")
+    ap.add_argument("--mb", type=int, default=4, help="payload MB/rank")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--ratio-max", type=float, default=0.3)
+    ap.add_argument("--intra-ms", type=float, default=0.1,
+                    help="simulated intra-domain (ICI) per-op latency")
+    ap.add_argument("--inter-ms", type=float, default=2.0,
+                    help="simulated cross-domain (DCN) per-op latency")
+    ap.add_argument("--hop-groups", type=int, nargs="+",
+                    default=[2, 4],
+                    help="groups-per-domain sweep for the hop phase")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    failures: List[str] = []
+    payload = {
+        "bench": "bench_hier",
+        "config": vars(args).copy(),
+        "bitwise": bitwise_phase(args, failures),
+        "counters": counter_phase(args, failures),
+        "hops": hop_phase(args, failures),
+        "convergence": convergence_phase(args, failures),
+    }
+    payload["failures"] = failures
+    payload["ok"] = not failures
+    blob = json.dumps(payload, indent=2, sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(blob + "\n")
+        print(f"wrote {args.out}")
+    print(blob if not args.out else json.dumps(
+        {k: payload[k] for k in ("ok", "failures")}, indent=2
+    ))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

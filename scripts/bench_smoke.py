@@ -401,6 +401,143 @@ def quantized_psum_smoke() -> "list[str]":
     return failures
 
 
+# One in-process HIERARCHICAL allreduce round (the ISSUE 13 gate):
+# 2 domains x 2 groups over the xla plane under a forced host device
+# count, int8 cross-tier. Three rounds of one layout so the (world,
+# codec, topology, domain-structure) executable cache is exercised;
+# prints compile/trace counts and the per-rank tier counters.
+_HIER_SMOKE = r"""
+import json, sys, threading
+import numpy as np
+sys.path.insert(0, sys.argv[1])
+from torchft_tpu.comm.topology import DomainTopology
+from torchft_tpu.comm.xla_backend import MeshManager, XlaCommContext
+
+world = 4
+smap = {"d0": ["rank0", "rank1"], "d1": ["rank2", "rank3"]}
+mm = MeshManager()
+ctxs = [
+    XlaCommContext(timeout=30.0, algorithm="star", compression="int8",
+                   chunk_bytes=1 << 14, mesh_manager=mm,
+                   topology="hier",
+                   domain_resolver=DomainTopology(static_map=smap))
+    for _ in range(world)
+]
+rng = np.random.default_rng(0)
+srcs = [
+    (rng.standard_normal(1 << 15) * (r + 1)).astype(np.float32)
+    for r in range(world)
+]
+errs = []
+
+def worker(rank):
+    try:
+        ctx = ctxs[rank]
+        ctx.configure("xla://hier_smoke", rank, world)
+        for _ in range(3):
+            data = srcs[rank].copy()
+            ctx.allreduce([data]).future().result(timeout=60)
+    except Exception as e:
+        errs.append(repr(e))
+
+threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=180)
+snaps = [c.metrics.snapshot() for c in ctxs]
+print(json.dumps({
+    "errors": errs, "compile_count": mm.compile_count,
+    "trace_count": mm.trace_count,
+    "raw_bytes_per_rank": int(srcs[0].nbytes) * 3,
+    "tiers": [
+        {k: s.get(k)
+         for k in ("comm_intra_bytes", "comm_inter_bytes", "comm_hops")}
+        for s in snaps
+    ],
+}))
+for c in ctxs:
+    c.shutdown()
+"""
+
+
+def hier_smoke() -> "list[str]":
+    """One in-process 2-domain x 2-group hierarchical round under a
+    forced host device count: fails on missing/non-finite tier counters
+    (``comm_intra_bytes``/``comm_inter_bytes``/``comm_hops``), an
+    inter/intra byte ratio above the int8 envelope, inter bytes on a
+    non-egress rank, or a compile count != 1 across repeated rounds of
+    one (world, codec, topology) key."""
+    import math
+
+    env = {
+        k: v for k, v in os.environ.items() if k not in ("PYTHONPATH",)
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    out = None
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _HIER_SMOKE, _REPO],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, timeout=300,
+        )
+        payload = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        stderr = getattr(e, "stderr", None)
+        if stderr is None and out is not None:
+            stderr = out.stderr
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+        tail = (stderr or "").strip()[-2000:]
+        suffix = f"\n  child stderr: {tail}" if tail else ""
+        return [f"hier smoke: child failed to produce JSON: {e!r}{suffix}"]
+    failures = [f"hier smoke: {e}" for e in payload.get("errors", [])]
+    if failures:
+        return failures
+    if payload.get("compile_count") != 1 or payload.get("trace_count") != 1:
+        failures.append(
+            "hier smoke: expected exactly 1 compile/trace for 3 rounds "
+            "of one (world, codec, topology) key, got "
+            f"compile={payload.get('compile_count')} "
+            f"trace={payload.get('trace_count')}"
+        )
+    tiers = payload.get("tiers") or []
+    raw = float(payload.get("raw_bytes_per_rank") or 0)
+    if len(tiers) != 4 or raw <= 0:
+        return failures + [
+            f"hier smoke: malformed tier payload: {payload!r}"
+        ]
+    for rank, t in enumerate(tiers):
+        for key in ("comm_intra_bytes", "comm_inter_bytes", "comm_hops"):
+            v = t.get(key)
+            if v is None or not math.isfinite(float(v)) or float(v) < 0:
+                failures.append(
+                    f"hier smoke: tier counter {key!r} missing/"
+                    f"non-finite on rank {rank}: {v!r}"
+                )
+    if failures:
+        return failures
+    intra = sum(t["comm_intra_bytes"] for t in tiers)
+    inter = sum(t["comm_inter_bytes"] for t in tiers)
+    if not intra or inter / intra > 0.3:
+        failures.append(
+            "hier smoke: inter/intra byte ratio "
+            f"{inter}/{intra} above the int8 envelope (0.3) — the "
+            "cross-domain tier is not compressing/narrowing"
+        )
+    for rank in (1, 3):  # non-egress ranks of the 2x2 map
+        if tiers[rank]["comm_inter_bytes"] != 0.0:
+            failures.append(
+                f"hier smoke: non-egress rank {rank} reported inter "
+                f"bytes {tiers[rank]['comm_inter_bytes']!r}"
+            )
+    return failures
+
+
 def events_smoke() -> "list[str]":
     """One in-process flight-recorder round: a solo Manager over a live
     lighthouse runs two committed steps, its event ring is dumped, and
@@ -630,6 +767,7 @@ def main() -> int:
     failures += diloco_smoke()
     failures += xla_smoke()
     failures += quantized_psum_smoke()
+    failures += hier_smoke()
     failures += events_smoke()
     failures += sharded_smoke()
     failures += fleet_smoke()
@@ -689,7 +827,7 @@ def main() -> int:
         f"events_recorded={payload.get('t1_events_recorded')} "
         f"opt_state_ratio={(payload.get('sharded') or {}).get('state_bytes_ratio')} "
         "heal_gauges=ok outer_gauges=ok xla_gauges=ok qpsum_gauges=ok "
-        "chrome_trace=ok sharded_gauges=ok fleet_gauges=ok"
+        "hier_gauges=ok chrome_trace=ok sharded_gauges=ok fleet_gauges=ok"
     )
     return 0
 

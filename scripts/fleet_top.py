@@ -149,12 +149,19 @@ def _fmt(v: Any, nd: int = 1) -> str:
 def build_row(ep: Dict[str, Any],
               polled: Optional[Dict[str, Any]],
               error: Optional[str] = None,
-              last_event: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+              last_event: Optional[Dict[str, Any]] = None,
+              prev_counters: Optional[Dict[str, float]] = None) -> Dict[str, Any]:
     """Flatten one endpoint's poll into the display row (pure — unit
     tested against canned payloads). ``last_event``: cached most-recent
     event for this endpoint, shown with a growing age when the
     INCREMENTAL poll returns nothing new — a wedged replica emitting no
-    events is exactly when the last-event column matters."""
+    events is exactly when the last-event column matters.
+    ``prev_counters``: the previous poll's cumulative tier-byte counters
+    for this endpoint (``comm_intra_bytes``/``comm_inter_bytes``); the
+    hier wire-byte columns are the Δ between polls, so a chatty
+    cross-DCN domain shows up as a growing ``Δinter_mb`` on its egress
+    row. The row carries the raw cumulative values back under
+    ``_counters`` for the caller's cache."""
     replica = str(ep.get("replica_id", "?"))[:24]
     if ep.get("domain"):
         replica = f"{ep['domain']}/{replica}"[:32]
@@ -169,6 +176,8 @@ def build_row(ep: Dict[str, Any],
         "heal_mb_s": None,
         "ddp_overlap": None,
         "outer_overlap": None,
+        "d_intra_mb": None,
+        "d_inter_mb": None,
         "last_event": "-",
         "error": error,
     }
@@ -191,6 +200,21 @@ def build_row(ep: Dict[str, Any],
     if wt and we is not None:
         row["ddp_overlap"] = max(0.0, min(1.0, 1.0 - we / wt))
     row["outer_overlap"] = m.get("outer_overlap")
+    counters = {
+        k: float(m[k])
+        for k in ("comm_intra_bytes", "comm_inter_bytes")
+        if m.get(k) is not None
+    }
+    row["_counters"] = counters
+    if prev_counters:
+        for key, col in (("comm_intra_bytes", "d_intra_mb"),
+                         ("comm_inter_bytes", "d_inter_mb")):
+            cur, prev = counters.get(key), prev_counters.get(key)
+            if cur is not None and prev is not None:
+                # a counter that moved BACKWARDS is a restarted process
+                # (fresh sink) — show its whole cumulative value, not a
+                # negative delta
+                row[col] = (cur - prev if cur >= prev else cur) / 1e6
     evs = polled.get("events", {}).get("events", [])
     last = evs[-1] if evs else last_event
     if last:
@@ -203,6 +227,7 @@ _COLUMNS = (
     ("replica", 34), ("rank", 4), ("step", 6), ("epoch", 5),
     ("committed", 9), ("discarded", 9), ("allreduce_p50_ms", 16),
     ("heal_mb_s", 9), ("ddp_overlap", 11), ("outer_overlap", 13),
+    ("d_intra_mb", 10), ("d_inter_mb", 10),
     ("last_event", 34),
 )
 
@@ -315,6 +340,7 @@ def main() -> int:
 
     cursors: Dict[str, int] = {}
     last_events: Dict[str, Dict[str, Any]] = {}
+    prev_counters: Dict[str, Dict[str, float]] = {}
 
     def _poll_one(ep: Dict[str, Any]) -> Dict[str, Any]:
         url = ep.get("url")
@@ -326,7 +352,13 @@ def main() -> int:
             evs = polled["events"].get("events") or []
             if evs:
                 last_events[url] = evs[-1]
-            return build_row(ep, polled, last_event=last_events.get(url))
+            row = build_row(
+                ep, polled, last_event=last_events.get(url),
+                prev_counters=prev_counters.get(url),
+            )
+            if row.get("_counters"):
+                prev_counters[url] = row["_counters"]
+            return row
         except Exception as e:  # noqa: BLE001
             return build_row(ep, None, error=repr(e)[:120])
 

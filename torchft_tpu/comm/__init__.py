@@ -14,6 +14,10 @@ from torchft_tpu.comm.context import (  # noqa: F401
     ReduceOp,
     Work,
 )
+from torchft_tpu.comm.topology import (  # noqa: F401
+    DomainAssignment,
+    DomainTopology,
+)
 from torchft_tpu.comm.transport import TcpCommContext  # noqa: F401
 from torchft_tpu.comm.subproc import SubprocessCommContext  # noqa: F401
 from torchft_tpu.comm.xla_backend import (  # noqa: F401

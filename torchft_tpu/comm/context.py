@@ -116,30 +116,39 @@ class CommContext(ABC):
         self._world_size = 1
 
     # ------------------------------------------------- capability query
-    # ONE definition of which (algorithm, compression, op) combos each
-    # backend can run, shared by ctor validation, Manager.comm_options
-    # and the bench sweeps (scripts/bench_transport.py) — so "can the
-    # psum path carry int8?" has exactly one answer everywhere instead
-    # of a hard ValueError here and a drifted copy there.
+    # ONE definition of which (algorithm, compression, op, topology)
+    # combos each backend can run, shared by ctor validation,
+    # Manager.comm_options and the bench sweeps
+    # (scripts/bench_transport.py) — so "can the psum path carry int8?"
+    # or "does the host plane run the hierarchical tier?" has exactly
+    # one answer everywhere instead of a hard ValueError here and a
+    # drifted copy there.
 
     @classmethod
     def unsupported_reason(
-        cls, algorithm: str, compression: str, op: str = ReduceOp.SUM
+        cls, algorithm: str, compression: str, op: str = ReduceOp.SUM,
+        topology: str = "flat",
     ) -> Optional[str]:
         """``None`` when this backend can run ``algorithm`` with
-        ``compression`` for reduce op ``op``; otherwise a PRESCRIPTIVE
-        error string (what to use instead). Real data planes override;
-        identity/test contexts move no bytes, so every combo is a
-        no-op they "support"."""
+        ``compression`` for reduce op ``op`` over ``topology`` ("flat" —
+        one tier spanning the whole wire — or "hier" — the
+        reduce-within → compress → exchange-across → broadcast-within
+        domain hierarchy); otherwise a PRESCRIPTIVE error string (what
+        to use instead). Real data planes override; identity/test
+        contexts move no bytes, so every combo is a no-op they
+        "support"."""
         return None
 
     @classmethod
     def supports(
-        cls, algorithm: str, compression: str, op: str = ReduceOp.SUM
+        cls, algorithm: str, compression: str, op: str = ReduceOp.SUM,
+        topology: str = "flat",
     ) -> bool:
         """Capability query: True when :meth:`unsupported_reason` is
         ``None`` for the combo."""
-        return cls.unsupported_reason(algorithm, compression, op) is None
+        return cls.unsupported_reason(
+            algorithm, compression, op, topology
+        ) is None
 
     @staticmethod
     def _prepare(a) -> np.ndarray:
@@ -161,10 +170,20 @@ class CommContext(ABC):
 
     @abstractmethod
     def allreduce(
-        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,
+        topology: Optional[str] = None,
     ) -> Work:
         """Reduce arrays across ranks. The returned work's future resolves
         to the reduced arrays (same shapes/dtypes, index-aligned).
+
+        ``topology`` selects the data path per op: ``"flat"`` (one tier
+        spanning the whole wire), ``"hier"`` (reduce-within a domain at
+        full precision → compress → exchange-across domains through the
+        elected egress ranks → broadcast-within; requires a context
+        configured for the hierarchical tier) or ``None`` (the
+        context's own default — flat unless constructed otherwise).
+        Identity/test contexts ignore it (every topology is a no-op on
+        a wire that moves no bytes).
 
         Ownership: the caller donates ``arrays`` — implementations may
         reduce in place and resolve the future to the submitted arrays
@@ -272,7 +291,8 @@ class DummyCommContext(CommContext):
         self.configure_count += 1
 
     def allreduce(
-        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,
+        topology: Optional[str] = None,
     ) -> Work:
         return CompletedWork(list(arrays))
 
@@ -336,11 +356,15 @@ class ErrorSwallowingCommContext(CommContext):
         return Work(out)
 
     def allreduce(
-        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,
+        topology: Optional[str] = None,
     ) -> Work:
         if self.errored() is not None:
             return CompletedWork(list(arrays))
-        return self._wrap(self._inner.allreduce(arrays, op), list(arrays))
+        return self._wrap(
+            self._inner.allreduce(arrays, op, topology=topology),
+            list(arrays),
+        )
 
     def reduce_scatter(
         self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,
@@ -392,14 +416,18 @@ class ErrorSwallowingCommContext(CommContext):
     # instance-level shadow of the classmethod: capability follows the
     # wrapped backend, not this wrapper's (identity) default
     def unsupported_reason(  # type: ignore[override]
-        self, algorithm: str, compression: str, op: str = ReduceOp.SUM
+        self, algorithm: str, compression: str, op: str = ReduceOp.SUM,
+        topology: str = "flat",
     ) -> Optional[str]:
-        return self._inner.unsupported_reason(algorithm, compression, op)
+        return self._inner.unsupported_reason(
+            algorithm, compression, op, topology
+        )
 
     def supports(  # type: ignore[override]
-        self, algorithm: str, compression: str, op: str = ReduceOp.SUM
+        self, algorithm: str, compression: str, op: str = ReduceOp.SUM,
+        topology: str = "flat",
     ) -> bool:
-        return self._inner.supports(algorithm, compression, op)
+        return self._inner.supports(algorithm, compression, op, topology)
 
 
 class ManagedCommContext(CommContext):
@@ -422,9 +450,12 @@ class ManagedCommContext(CommContext):
         )
 
     def allreduce(
-        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,
+        topology: Optional[str] = None,
     ) -> Work:
-        return self._manager.allreduce_arrays(arrays, op=op)
+        return self._manager.allreduce_arrays(
+            arrays, op=op, topology=topology
+        )
 
     def reduce_scatter(
         self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,
@@ -471,13 +502,17 @@ class ManagedCommContext(CommContext):
         return self._manager.wire_nbytes(a)
 
     def unsupported_reason(  # type: ignore[override]
-        self, algorithm: str, compression: str, op: str = ReduceOp.SUM
+        self, algorithm: str, compression: str, op: str = ReduceOp.SUM,
+        topology: str = "flat",
     ) -> Optional[str]:
         return self._manager.comm_unsupported_reason(
-            algorithm, compression, op
+            algorithm, compression, op, topology
         )
 
     def supports(  # type: ignore[override]
-        self, algorithm: str, compression: str, op: str = ReduceOp.SUM
+        self, algorithm: str, compression: str, op: str = ReduceOp.SUM,
+        topology: str = "flat",
     ) -> bool:
-        return self.unsupported_reason(algorithm, compression, op) is None
+        return self.unsupported_reason(
+            algorithm, compression, op, topology
+        ) is None

@@ -55,8 +55,10 @@ def _child_main(tx: "mp.Queue", rx: "mp.Queue", timeout: float,
             cmd = tx.get()
             kind = cmd[0]
             if kind == _CMD_CONFIGURE:
-                _, store_addr, rank, world_size = cmd
+                _, store_addr, rank, world_size, members = cmd
                 try:
+                    if members is not None:
+                        ctx.set_wire_members(members)
                     ctx.configure(store_addr, rank, world_size)
                     rx.put(("ok", None))
                 except Exception as e:  # noqa: BLE001
@@ -65,7 +67,11 @@ def _child_main(tx: "mp.Queue", rx: "mp.Queue", timeout: float,
                 _, opcode, op, root, arrays = cmd
                 try:
                     if opcode == "allreduce":
-                        work = ctx.allreduce(arrays, op)
+                        # ``root`` carries the per-op topology override
+                        # for this opcode (None = the child context's
+                        # ctor default) — same slot-reuse trick as
+                        # reduce_scatter's owners below.
+                        work = ctx.allreduce(arrays, op, topology=root)
                     elif opcode == "reduce_scatter":
                         # ``root`` carries the owners list for this
                         # opcode (unused otherwise) — keeps the command
@@ -166,20 +172,25 @@ class SubprocessCommContext(CommContext):
                  algorithm: str = "auto", channels: int = 4,
                  compression: str = "none",
                  chunk_bytes: int = 1 << 20,
-                 stripe: bool = True) -> None:
+                 stripe: bool = True,
+                 topology: str = "flat") -> None:
         """``algorithm``/``channels``/``compression``/``chunk_bytes``/
-        ``stripe`` are forwarded to the child's TcpCommContext (see
-        transport.py for their semantics)."""
+        ``stripe``/``topology`` are forwarded to the child's
+        TcpCommContext (see transport.py for their semantics; the
+        child resolves hier domains from its own TORCHFT_TPU_DOMAINS
+        env or the wire members shipped with each configure)."""
         super().__init__()
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
         self._timeout = float(timeout)
+        self._wire_members = None
         self._transport_kwargs = {
             "algorithm": algorithm,
             "channels": channels,
             "compression": compression,
             "chunk_bytes": chunk_bytes,
             "stripe": stripe,
+            "topology": topology,
         }
         self._mp = mp.get_context("spawn")
         self._epoch: Optional[_Epoch] = None
@@ -188,12 +199,20 @@ class SubprocessCommContext(CommContext):
 
     @classmethod
     def unsupported_reason(cls, algorithm: str, compression: str,
-                           op: str = ReduceOp.SUM) -> "Optional[str]":
+                           op: str = ReduceOp.SUM,
+                           topology: str = "flat") -> "Optional[str]":
         # The child owns a TcpCommContext — capability IS the host
         # plane's (one shared definition, transport.py).
         from torchft_tpu.comm.transport import host_unsupported_reason
 
-        return host_unsupported_reason(algorithm, compression, op)
+        return host_unsupported_reason(algorithm, compression, op,
+                                       topology)
+
+    def set_wire_members(self, members) -> None:
+        """Cohort replica ids (transport rank order), shipped to the
+        child with the next configure — the hier domain resolver's
+        input (see TcpCommContext.set_wire_members)."""
+        self._wire_members = [str(m) for m in members]
 
     # ------------------------------------------------------------ lifecycle
 
@@ -212,7 +231,10 @@ class SubprocessCommContext(CommContext):
         epoch = _Epoch(self._mp, self._timeout,
                        self._transport_kwargs)
         epoch.proc.start()
-        epoch.tx.put((_CMD_CONFIGURE, store_addr, rank, world_size))
+        epoch.tx.put((
+            _CMD_CONFIGURE, store_addr, rank, world_size,
+            self._wire_members,
+        ))
         try:
             status, payload = epoch.rx.get(timeout=self._timeout + 10)
         except queue_mod.Empty:
@@ -266,8 +288,9 @@ class SubprocessCommContext(CommContext):
         )
         return Work(fut)
 
-    def allreduce(self, arrays, op: str = ReduceOp.SUM) -> Work:
-        return self._submit("allreduce", arrays, op, 0)
+    def allreduce(self, arrays, op: str = ReduceOp.SUM,
+                  topology=None) -> Work:
+        return self._submit("allreduce", arrays, op, topology)
 
     def reduce_scatter(self, arrays, op: str = ReduceOp.SUM,
                        owners=None) -> Work:

@@ -686,12 +686,16 @@ def codec_roundtrip(codec, chunk_bytes: int, src: np.ndarray,
 
 
 def host_unsupported_reason(algorithm: str, compression: str,
-                            op: str = ReduceOp.SUM) -> "Optional[str]":
+                            op: str = ReduceOp.SUM,
+                            topology: str = "flat") -> "Optional[str]":
     """THE host-plane capability rule (CommContext.unsupported_reason):
     shared by TcpCommContext and its subprocess proxy so the two can
     never drift. The socket transport runs every codec on star/ring/auto
-    for every reduce op; ``psum`` is the on-device hardware-native path
-    and does not exist on sockets."""
+    for every reduce op, on both the flat tier and the hierarchical
+    domain tier (``topology="hier"``: the intra tier is always
+    full-precision star; ``algorithm`` selects the cross-domain tier's
+    wire — star fan-in or the multi-hop ring); ``psum`` is the on-device
+    hardware-native path and does not exist on sockets."""
     if algorithm == "psum":
         return (
             "algorithm='psum' is the on-device hardware-native path "
@@ -704,6 +708,12 @@ def host_unsupported_reason(algorithm: str, compression: str,
     if compression not in _CODECS:
         return (
             f"unknown compression {compression!r}; have {sorted(_CODECS)}"
+        )
+    if topology not in ("flat", "hier"):
+        return (
+            f"unknown topology {topology!r}; have 'flat' (one tier "
+            "spanning the wire) and 'hier' (domain tree: reduce-within "
+            "-> compress -> exchange-across -> broadcast-within)"
         )
     return None
 
@@ -1316,6 +1326,69 @@ class _Lane:
                     np.divide(f, n, out=f)
 
 
+# ------------------------------------------------------ hierarchical tier
+# The DynamiQ-shaped multi-hop data plane (docs/architecture.md,
+# "Hierarchical data plane"): reduce-within a domain at FULL precision
+# over a private intra-tier star (the ICI/rack hop — cheap bytes), then
+# exchange ACROSS domains through one elected egress rank per domain with
+# the configured wire codec applied (the DCN hop — the expensive bytes,
+# encoded exactly once), then broadcast the decoded global result back
+# within each domain. Cross-DCN bytes therefore scale with DOMAIN
+# fan-out, not world size: only egress ranks touch the inter tier, and
+# they ship encoded domain sums. Composed from child TcpCommContexts so
+# every wire property (framing, duplex exchange, chunk grid, codec bits,
+# error latching) is the one existing implementation.
+
+
+class _HierState:
+    """One configure-epoch's hierarchical machinery: the resolved
+    :class:`~torchft_tpu.comm.topology.DomainAssignment`, the intra-tier
+    child context (absent for a 1-member domain), the inter-tier child
+    context (egress ranks only), and the 1-thread executor running each
+    op's three-phase composition in submission order (the same
+    per-stream ordering contract as the lanes)."""
+
+    __slots__ = ("assignment", "intra", "inter", "exec", "rank",
+                 "group", "n_domains", "inter_hops")
+
+    def __init__(self, assignment, rank: int) -> None:
+        import concurrent.futures as _cf
+
+        self.assignment = assignment
+        self.rank = rank
+        self.group = assignment.group_of(rank)
+        self.n_domains = assignment.n_domains
+        self.intra: "Optional[TcpCommContext]" = None
+        self.inter: "Optional[TcpCommContext]" = None
+        self.inter_hops = 0
+        self.exec = _cf.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="torchft_tpu_hier"
+        )
+
+    def shutdown(self) -> None:
+        self.exec.shutdown(wait=False)
+        for ctx in (self.intra, self.inter):
+            if ctx is not None:
+                ctx.shutdown()
+
+    def hops(self) -> int:
+        """Sequential point-to-point exchange rounds on THIS rank's
+        critical path for one hier op: reduce-to-egress (1, the
+        narrowed reduce_scatter — no wasted fan-out of a value the
+        global broadcast overwrites) + the inter tier (2 for star
+        fan-in, 2(d-1) for the multi-hop ring) + broadcast-within (1).
+        A function of domain size and domain COUNT — never of world
+        size (the counter-shaped win `comm_hops` pins; flat ring is
+        2(world-1))."""
+        m = len(self.group)
+        hops = 0
+        if m > 1:
+            hops += 2  # reduce-to-egress + broadcast-within
+        if self.n_domains > 1:
+            hops += self.inter_hops
+        return hops
+
+
 class TcpCommContext(CommContext):
     """Reconfigurable collective context over TCP (star or ring wire
     topology; see class ctor)."""
@@ -1326,7 +1399,9 @@ class TcpCommContext(CommContext):
                  algorithm: str = "auto", channels: int = 4,
                  compression: str = "none",
                  chunk_bytes: int = 1 << 20,
-                 stripe: bool = True) -> None:
+                 stripe: bool = True,
+                 topology: str = "flat",
+                 domain_resolver=None) -> None:
         """``algorithm``: "star" (rank 0 reduces and fans out — lowest
         latency for tiny payloads / few replicas), "ring" (bandwidth-optimal
         reduce-scatter + all-gather: each link moves ~2B/n per allreduce
@@ -1355,11 +1430,27 @@ class TcpCommContext(CommContext):
         (chunk c -> lane (base + c) % channels) so a single large payload
         uses every socket concurrently; False pins every chunk to the
         op's round-robin lane (the one-op-one-lane PR 1 model, kept as an
-        A/B lever for the bench). Must match across ranks."""
+        A/B lever for the bench). Must match across ranks.
+
+        ``topology``: the DEFAULT data path for allreduce ops — "flat"
+        (one tier spanning the whole wire; the historical behavior) or
+        "hier" (the domain hierarchy: configure additionally builds the
+        intra/inter tier child transports and allreduce rides
+        reduce-within → compress → exchange-across → broadcast-within;
+        per-op ``allreduce(..., topology=...)`` overrides, which is the
+        bench's A/B lever). Must match across ranks.
+
+        ``domain_resolver``: a ``comm.topology.DomainTopology`` naming
+        each replica's domain; wire rank 0 resolves the cohort and
+        publishes the assignment on the rendezvous store, so only one
+        rank strictly needs a resolver. Default: built from the
+        ``TORCHFT_TPU_DOMAINS`` env map on first hier configure."""
         super().__init__()
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
-        reason = self.unsupported_reason(algorithm, compression)
+        reason = self.unsupported_reason(
+            algorithm, compression, topology=topology
+        )
         if reason is not None:
             raise ValueError(reason)
         if channels < 1:
@@ -1367,10 +1458,15 @@ class TcpCommContext(CommContext):
         if chunk_bytes < 0:
             raise ValueError("chunk_bytes must be >= 0")
         self._codec = _CODECS[compression]()
+        self._compression = compression
         self._chunk_bytes = int(chunk_bytes)
         self._stripe = bool(stripe)
         self._algorithm = algorithm
         self._channels = int(channels)
+        self._topology_default = topology
+        self._domain_resolver = domain_resolver
+        self._wire_members: "Optional[List[str]]" = None
+        self._hier: "Optional[_HierState]" = None
         self._use_ring = False
         self._timeout = float(timeout)
         self._generation = 0
@@ -1389,8 +1485,27 @@ class TcpCommContext(CommContext):
 
     @classmethod
     def unsupported_reason(cls, algorithm: str, compression: str,
-                           op: str = ReduceOp.SUM) -> Optional[str]:
-        return host_unsupported_reason(algorithm, compression, op)
+                           op: str = ReduceOp.SUM,
+                           topology: str = "flat") -> Optional[str]:
+        return host_unsupported_reason(algorithm, compression, op, topology)
+
+    def set_wire_members(self, members: "Sequence[str]") -> None:
+        """Replica ids of the upcoming cohort in transport rank order
+        (the Manager calls this from each quorum before ``configure``) —
+        what the domain resolver maps to tier structure. Without it, a
+        hier configure synthesizes ``rank{r}`` names so harnesses and
+        benches can address ranks in a ``TORCHFT_TPU_DOMAINS`` map."""
+        self._wire_members = [str(m) for m in members]
+
+    def set_domain_resolver(self, resolver) -> None:
+        """Install a DomainTopology unless the ctor already provided
+        one (explicit wins) — the Manager wires a resolver homed to the
+        job's lighthouse ``/status.json`` here, so a managed hier job
+        needs zero topology plumbing. Only wire rank 0 ever consults it
+        (the resolved assignment is published on the rendezvous store
+        for the rest of the cohort)."""
+        if self._domain_resolver is None:
+            self._domain_resolver = resolver
 
     def set_metrics(self, metrics: Metrics) -> None:
         """Record lane phase timings into ``metrics`` (call before
@@ -1435,6 +1550,14 @@ class TcpCommContext(CommContext):
         else:
             self._configure_star(store, rank, world_size, lanes)
         self._install_lanes(lanes)
+        if self._topology_default == "hier":
+            try:
+                self._configure_hier(store_addr, rank, world_size, store)
+            except Exception:
+                # a half-built tier must not leak child sockets; the
+                # caller (Manager) latches and retries next quorum
+                self.shutdown()
+                raise
 
     def _install_lanes(self, lanes: List[_Lane]) -> None:
         for lane in lanes:
@@ -1590,13 +1713,224 @@ class TcpCommContext(CommContext):
                 f"ring configure: rank {rank} could not link the ring: {e}"
             ) from e
 
+    # --------------------------------------------------- hierarchical tier
+
+    def _resolved_inter_algorithm(self, n_domains: int) -> str:
+        """The cross-domain tier's wire. "auto" picks STAR regardless of
+        domain count: the egress fan-in encodes each contribution
+        exactly once (the single-quantization error bound) and every
+        cross-DCN byte rides the codec — the property the inter-bytes
+        envelope is graded on. Explicit "ring" selects the multi-hop
+        rotation (bandwidth-optimal at many domains; its reduce-scatter
+        hops carry partial sums UNCOMPRESSED by the PR 2 rule, so more
+        of the cross-tier traffic is raw — the documented trade)."""
+        return "star" if self._algorithm == "auto" else self._algorithm
+
+    def _configure_hier(self, store_addr: str, rank: int,
+                        world_size: int, store) -> None:
+        """Build this epoch's domain tier on top of the flat lanes:
+        resolve (or receive) the cohort's DomainAssignment, then
+        configure the intra-tier child (this rank's domain, rank 0 = the
+        elected egress) and — on egress ranks — the inter-tier child
+        (one rank per domain, domain order = sorted names).
+
+        Cohort synchronization: wire rank 0 resolves through the
+        DomainTopology resolver and PUBLISHES the assignment on the
+        rendezvous store; every other rank adopts the published copy, so
+        a mid-quorum live-map refresh can never split the cohort into
+        disagreeing tier structures."""
+        from torchft_tpu.comm.topology import DomainAssignment
+
+        members = self._wire_members
+        if members is None or len(members) != world_size:
+            members = [f"rank{r}" for r in range(world_size)]
+        if rank == 0:
+            resolver = self._domain_resolver
+            if resolver is None:
+                from torchft_tpu.comm.topology import DomainTopology
+
+                resolver = self._domain_resolver = DomainTopology()
+            assignment = resolver.assign(members)
+            store.set("hier_map", assignment.to_json())
+        else:
+            assignment = DomainAssignment.from_json(
+                store.wait("hier_map", timeout=self._timeout)
+            )
+        h = _HierState(assignment, rank)
+        group = h.group
+        d_idx = assignment.domain_index(rank)
+        try:
+            if len(group) > 1:
+                # reduce-within rides a full-precision star: the egress
+                # (intra rank 0) is the root whose accumulator the
+                # domain sum lands in, and the same child later serves
+                # the broadcast-within fan-out.
+                h.intra = TcpCommContext(
+                    timeout=self._timeout, algorithm="star",
+                    channels=self._channels, compression="none",
+                    chunk_bytes=self._chunk_bytes, stripe=self._stripe,
+                )
+                h.intra.configure(
+                    f"{store_addr}/hier_intra_{d_idx}",
+                    group.index(rank), len(group),
+                )
+            if h.n_domains > 1:
+                inter_algo = self._resolved_inter_algorithm(h.n_domains)
+                use_ring = inter_algo == "ring"
+                h.inter_hops = (
+                    2 * (h.n_domains - 1) if use_ring else 2
+                )
+                if assignment.is_egress(rank):
+                    # the only rank of this domain whose bytes cross
+                    # DCN — encoded through the configured codec
+                    h.inter = TcpCommContext(
+                        timeout=self._timeout, algorithm=inter_algo,
+                        channels=self._channels,
+                        compression=self._compression,
+                        chunk_bytes=self._chunk_bytes,
+                        stripe=self._stripe,
+                    )
+                    h.inter.configure(
+                        f"{store_addr}/hier_inter", d_idx, h.n_domains
+                    )
+        except Exception:
+            h.shutdown()
+            raise
+        with self._lock:
+            self._hier = h
+        ev = self._events
+        if ev:
+            # one event per installed exchange plan (configure-rate, not
+            # op-rate): the postmortem anchor for "which tier structure
+            # was this cohort reducing over?"
+            ev.emit(
+                "hier_exchange", world=world_size,
+                domains=h.n_domains, egress=list(assignment.egress),
+                domain=assignment.domains[rank],
+                is_egress=assignment.is_egress(rank),
+                fingerprint=assignment.fingerprint,
+            )
+
+    def _submit_hier(self, arrays: Sequence[np.ndarray], op: str) -> Work:
+        fut: Future = Future()
+        fut.set_running_or_notify_cancel()
+        err = self.errored()
+        if err is not None:
+            fut.set_exception(
+                ConnectionError(f"comm context previously errored: {err}")
+            )
+            return Work(fut)
+        prepared = [self._prepare(a) for a in arrays]
+        with self._lock:
+            h = self._hier
+            world = self._world_size
+            configured = bool(self._lanes)
+        if world == 1:
+            # solo wire: identity, exactly like the flat path
+            if not configured:
+                fut.set_exception(
+                    RuntimeError("comm context not configured")
+                )
+            else:
+                fut.set_result(prepared)
+            return Work(fut)
+        if h is None:
+            fut.set_exception(RuntimeError(
+                "topology='hier' requires a context configured with the "
+                "hierarchical tier — construct TcpCommContext("
+                "topology='hier') (and configure it) or use "
+                "topology='flat' for this op"
+            ))
+            return Work(fut)
+        h.exec.submit(self._run_hier, h, prepared, op, fut)
+        return Work(fut)
+
+    def _run_hier(self, h: "_HierState", arrays: List[np.ndarray],
+                  op: str, fut: Future) -> None:
+        """One op's three-phase composition, on the hier executor:
+        reduce-within (full-precision star SUM/MAX/... — the donated
+        arrays hold the domain sum in place), exchange-across (egress
+        only; the codec encodes each domain sum exactly once and every
+        domain decodes identical bytes), broadcast-within (raw f32 —
+        all ranks globally identical afterwards), then the AVG divide.
+        Any phase failure latches like a dead socket: an egress dying
+        mid-exchange fails its domain's broadcast by timeout and the
+        next quorum re-elects (min surviving rank)."""
+        t0 = time.perf_counter()
+        metrics = self.metrics
+        phase_timeout = self._timeout + 15.0
+        try:
+            tier_op = ReduceOp.SUM if op == ReduceOp.AVG else op
+            m = len(h.group)
+            if m > 1:
+                # reduce-TO-EGRESS: the narrowed reduce_scatter (every
+                # array owned by intra rank 0) delivers the domain sum
+                # to the egress alone, bitwise identical to what an
+                # allreduce would produce there — without fanning out a
+                # value the global broadcast below overwrites unread on
+                # every other member (one hop, not two)
+                h.intra.reduce_scatter(
+                    arrays, tier_op, owners=[0] * len(arrays)
+                ).future().result(timeout=phase_timeout)
+            if h.n_domains > 1 and h.inter is not None:
+                h.inter.allreduce(arrays, tier_op).future().result(
+                    timeout=phase_timeout
+                )
+            if m > 1:
+                res = h.intra.broadcast(arrays, root=0).future().result(
+                    timeout=phase_timeout
+                )
+                for a, r in zip(arrays, res):
+                    np.copyto(a, r)
+            if op == ReduceOp.AVG:
+                for a in arrays:
+                    np.divide(a, self._world_size, out=a)
+            # Tier byte accounting, same convention as comm_raw_bytes/
+            # comm_encoded_bytes (ONE direction, THIS rank's
+            # contribution): intra = the raw full-precision domain hop,
+            # inter = the encoded cross-DCN hop — zero on non-egress
+            # ranks, which is exactly the scaling the hier path exists
+            # for (Δinter sums over ranks to f(domains), not f(world)).
+            raw_b = float(sum(a.nbytes for a in arrays))
+            metrics.incr("comm_intra_bytes", raw_b if m > 1 else 0.0)
+            inter_b = 0.0
+            if h.inter is not None and h.n_domains > 1:
+                enc_b = float(sum(self.wire_nbytes(a) for a in arrays))
+                if h.inter._use_ring:
+                    # multi-hop honesty: the ring's reduce-scatter hops
+                    # carry RAW partial sums (the PR 2 no-recompression
+                    # rule) and only the all-gather rotation is
+                    # encoded — charge (d-1)/d of each, per direction
+                    d = h.n_domains
+                    inter_b = (raw_b + enc_b) * (d - 1) / d
+                else:
+                    inter_b = enc_b  # star: the encoded contribution
+            metrics.incr("comm_inter_bytes", inter_b)
+            metrics.incr("comm_hops", float(h.hops()))
+            metrics.observe("comm_op_wire", time.perf_counter() - t0)
+            fut.set_result(arrays)
+        except Exception as e:  # noqa: BLE001 — latch every tier error
+            self._latch_error(e)
+            logger.warning(
+                "hier comm op failed (rank %d world %d domain %s): %s",
+                self._rank, self._world_size,
+                h.assignment.domains[h.rank], e,
+            )
+            try:
+                fut.set_exception(e)
+            except Exception:
+                pass
+
     def shutdown(self) -> None:
         with self._lock:
             lanes = self._lanes
             self._lanes = []
+            hier, self._hier = self._hier, None
             for lane in lanes:
                 lane._queue.put(None)  # sentinel; guarded so no op can be
                 # enqueued after it (see _submit)
+        if hier is not None:
+            hier.shutdown()
         for lane in lanes:
             lane.close_sockets()
         if self._listener is not None:
@@ -1654,14 +1988,37 @@ class TcpCommContext(CommContext):
         not just codec-aware: the star root's contribution is the
         in-place accumulator (never encoded) and ring contributions ride
         uncompressed partial sums, so only star PEERS are compensable.
+
+        Hier default topology: the codec runs ONLY on the inter tier, so
+        the compensable roles are the inter tier's — an EGRESS rank
+        whose encoded domain sum crosses DCN through a role the inter
+        child reports compensable (star inter: every egress but the
+        fan-in root). The residual the EF arena banks is then the codec
+        image of this rank's OWN contribution — an approximation of the
+        domain-sum error that is exact for 1-member domains and feeds
+        the quantization error back into the system exactly once per
+        round either way (the toy-quadratic convergence oracle pins
+        that it tracks fp32). Non-egress ranks ship only raw
+        full-precision bytes: never compensable.
         Valid only after configure() for the current membership."""
         with self._lock:
-            return (
+            hier_mode = self._topology_default == "hier"
+            h = self._hier
+            flat = (
                 type(self._codec) is not _NoCodec
                 and self._world_size > 1
                 and not self._use_ring
                 and self._rank != 0
             )
+        if hier_mode:
+            # child lock taken OUTSIDE ours (no nesting)
+            return (
+                type(self._codec) is not _NoCodec
+                and h is not None
+                and h.inter is not None
+                and h.inter.wire_compensable()
+            )
+        return flat
 
     def wire_roundtrip(self, src: np.ndarray, out: np.ndarray) -> None:
         """Write the wire's image of THIS rank's allreduce contribution
@@ -1786,8 +2143,45 @@ class TcpCommContext(CommContext):
         return Work(fut)
 
     def allreduce(
-        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,
+        topology: Optional[str] = None,
     ) -> Work:
+        topo = topology if topology is not None else self._topology_default
+        if (
+            topo != self._topology_default
+            and type(self._codec) is not _NoCodec
+        ):
+            # The EF arena keys its residual roles off the CONTEXT's
+            # wire_compensable (which reflects the default topology's
+            # encoding roles); a per-op override under a lossy codec
+            # would bank residuals against a wire the op never rode —
+            # a systematic gradient bias. Refuse prescriptively: the
+            # per-op lever stays for codec='none' A/Bs; lossy arms get
+            # their own context.
+            fut: Future = Future()
+            fut.set_running_or_notify_cancel()
+            fut.set_exception(ValueError(
+                f"per-op topology={topo!r} differs from this context's "
+                f"default {self._topology_default!r} under the lossy "
+                f"{self._codec.name!r} codec — the error-feedback roles "
+                "(wire_compensable) follow the default topology, so the "
+                "override would desynchronize EF from the actual wire. "
+                "Construct a context with topology="
+                f"{topo!r} for this arm, or use compression='none' for "
+                "a per-op A/B"
+            ))
+            return Work(fut)
+        if topo == "hier":
+            return self._submit_hier(arrays, op)
+        if topo != "flat":
+            fut = Future()
+            fut.set_running_or_notify_cancel()
+            fut.set_exception(ValueError(
+                host_unsupported_reason(
+                    self._algorithm, self._codec.name, op, topo
+                ) or f"unknown topology {topo!r}"
+            ))
+            return Work(fut)
         return self._submit(_OP_ALLREDUCE, arrays, op, 0)
 
     def reduce_scatter(

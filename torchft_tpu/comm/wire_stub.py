@@ -138,18 +138,29 @@ class WireStubManager:
         return self._ctx.wire_nbytes(a)
 
     def comm_unsupported_reason(self, algorithm, compression,
-                                op=ReduceOp.SUM):
-        return self._ctx.unsupported_reason(algorithm, compression, op)
+                                op=ReduceOp.SUM, topology="flat"):
+        return self._ctx.unsupported_reason(
+            algorithm, compression, op, topology
+        )
 
-    def comm_supports(self, algorithm, compression, op=ReduceOp.SUM) -> bool:
-        return self._ctx.supports(algorithm, compression, op)
+    def comm_supports(self, algorithm, compression, op=ReduceOp.SUM,
+                      topology="flat") -> bool:
+        return self._ctx.supports(algorithm, compression, op, topology)
 
     def transport_rank(self) -> int:
         rank = getattr(self._ctx, "rank", None)
         return int(rank()) if callable(rank) else 0
 
-    def allreduce_arrays(self, arrays, op=ReduceOp.SUM) -> Work:
-        work = self._ctx.allreduce(list(arrays), ReduceOp.SUM)
+    def allreduce_arrays(self, arrays, op=ReduceOp.SUM,
+                         topology=None) -> Work:
+        # kwarg omitted when None, mirroring the real Manager — a
+        # wrapped context predating the topology parameter keeps working
+        if topology is None:
+            work = self._ctx.allreduce(list(arrays), ReduceOp.SUM)
+        else:
+            work = self._ctx.allreduce(
+                list(arrays), ReduceOp.SUM, topology=topology
+            )
         scale = np.float32(1.0 / self._world)
 
         def _avg(f: Future):

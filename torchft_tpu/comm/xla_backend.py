@@ -865,6 +865,263 @@ def _build_quantized_psum_scatter(mesh_mgr: MeshManager, world_size: int,
         return jax.jit(fn).lower(*avals).compile(), (rep, row)
 
 
+# ------------------------------------------------- hierarchical builders
+
+
+def _build_hier_allreduce(mesh_mgr: MeshManager, world_size: int,
+                          codec_name: str, chunk_bytes: int, op: str,
+                          layouts: Sequence[Tuple[int, np.dtype]],
+                          groups: Sequence[Sequence[int]]):
+    """Compile ONE deterministic hierarchical allreduce (the parity
+    composition — bit-matching the host transport's hier path, which is
+    the bitwise oracle at ``codec="none"``): per grid chunk,
+
+    1. **reduce-within**: each domain's rows accumulate at full
+       precision in wire-rank order (the host intra star's order),
+    2. **exchange-across**: domain sums combine in domain order with
+       the star fan-in semantics — domain 0's sum raw, every other
+       domain's sum ``dec(enc(·))`` through the wire codec, the result
+       re-encoded once so every rank decodes identical bytes (lossy
+       codecs; trajectory consistency),
+    3. **broadcast-within** is implicit (every rank computes the same
+       composition from the gathered rows — on the single-process
+       emulation the rows are already co-resident; the
+       ``comm_intra_bytes``/``comm_inter_bytes`` counters model the
+       real tiered wire, exactly like the flat parity modes).
+
+    ``groups`` lists each domain's wire ranks in domain order. Cached
+    per (world, codec, grid, op, layouts, domain structure) like every
+    PR 6 collective."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = world_size
+    mesh = mesh_mgr.mesh_for(n)
+    axis = mesh_mgr.axis_name
+    lossy = codec_name != "none"
+    groups = tuple(tuple(int(r) for r in g) for g in groups)
+
+    def comb(acc, new, z):
+        if op in (ReduceOp.SUM, ReduceOp.AVG):
+            out = acc + new
+            return _hardround(out, z) if _is_float(out.dtype) else out
+        if op == ReduceOp.MAX:
+            return jnp.maximum(acc, new)
+        if op == ReduceOp.MIN:
+            return jnp.minimum(acc, new)
+        raise ValueError(f"unsupported reduce op: {op}")
+
+    def reduce_chunk_hier(g, s, e, z):
+        dsums = []
+        for ranks in groups:
+            acc = g[ranks[0], s:e]
+            for r in ranks[1:]:
+                acc = comb(acc, g[r, s:e], z)
+            dsums.append(acc)
+        acc = dsums[0]
+        if len(dsums) > 1:
+            for dsum in dsums[1:]:
+                acc = comb(acc, _dev_enc_dec(codec_name, dsum, z), z)
+            if lossy:
+                # encode-once of the global result: the host inter
+                # star root's final re-encode, so every domain decodes
+                # identical bytes
+                acc = _dev_enc_dec(codec_name, acc, z)
+        if op == ReduceOp.AVG:
+            acc = acc / jnp.float32(n)
+            acc = _hardround(acc, z) if _is_float(acc.dtype) else acc
+        return acc
+
+    def fn(z, *stacked):
+        def local(z, *rows):
+            outs = []
+            for row, (size, dt) in zip(rows, layouts):
+                g = jax.lax.all_gather(row[0], axis)
+                parts = [
+                    reduce_chunk_hier(g, s, e, z)
+                    for (s, e) in _grid_bounds(
+                        size, chunk_bytes, np.dtype(dt).itemsize
+                    )
+                ]
+                out = (
+                    jnp.concatenate(parts) if len(parts) > 1
+                    else parts[0] if parts
+                    else jnp.zeros((0,), dt)
+                )
+                outs.append(jnp.expand_dims(out, 0))
+            return tuple(outs)
+
+        mesh_mgr._note_trace()
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(),) + tuple(P(axis) for _ in stacked),
+            out_specs=tuple(P(axis) for _ in stacked),
+            check_rep=False,
+        )(z, *stacked)
+
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P(axis))
+    avals = [jax.ShapeDtypeStruct((), np.int32, sharding=rep)] + [
+        jax.ShapeDtypeStruct((n, size), np.dtype(dt), sharding=row)
+        for (size, dt) in layouts
+    ]
+    with _x64_trace():
+        return jax.jit(fn).lower(*avals).compile(), (rep, row)
+
+
+def _build_hier_psum(mesh_mgr: MeshManager, world_size: int,
+                     codec_name: str, chunk_bytes: int, op: str,
+                     layouts: Sequence[Tuple[int, np.dtype]],
+                     groups: Sequence[Sequence[int]],
+                     egress: Sequence[int]):
+    """Compile the HARDWARE-NATIVE hierarchical allreduce: a
+    full-precision ``psum`` restricted to each domain via
+    ``axis_index_groups`` (the ICI hop XLA schedules natively), then —
+    for lossy codecs — a per-chunk encode of the domain sum on the PR 2
+    grid (shared ``_dev_enc_dec`` scale math, bit-matching the host
+    codec), and a second ``psum`` of the egress-masked decoded images
+    (each domain contributes its encoded sum exactly once — the
+    cross-DCN hop, encoded bytes only). Like raw ``psum``, XLA owns the
+    reduction order, so this path is NUMERIC (outside the bitwise A/B);
+    extrema are idempotent across tiers and lower to a plain
+    ``pmax``/``pmin`` (lossy extrema are refused by the capability
+    rule). Cached per (world, codec, grid, op, layouts, domain
+    structure)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = world_size
+    mesh = mesh_mgr.mesh_for(n)
+    axis = mesh_mgr.axis_name
+    lossy = codec_name != "none"
+    groups = [list(int(r) for r in g) for g in groups]
+    n_domains = len(groups)
+    egress_mask_np = np.zeros((n,), np.bool_)
+    for r in egress:
+        egress_mask_np[int(r)] = True
+
+    def fn(z, *stacked):
+        def local(z, *rows):
+            d = lax.axis_index(axis)
+            is_egress = jnp.asarray(egress_mask_np)[d]
+            outs = []
+            for row, (size, dt) in zip(rows, layouts):
+                x = row[0]
+                if size == 0:
+                    outs.append(jnp.zeros((1, 0), np.dtype(dt)))
+                    continue
+                if op == ReduceOp.MAX:
+                    outs.append(jnp.expand_dims(lax.pmax(x, axis), 0))
+                    continue
+                if op == ReduceOp.MIN:
+                    outs.append(jnp.expand_dims(lax.pmin(x, axis), 0))
+                    continue
+                if np.dtype(dt) != np.float32 or n_domains == 1:
+                    # non-f32 never compresses (the host gate) and a
+                    # single domain has no cross tier: accumulate flat
+                    red = lax.psum(x, axis)
+                else:
+                    dsum = lax.psum(
+                        x, axis, axis_index_groups=groups
+                    )
+                    if lossy:
+                        parts = [
+                            _dev_enc_dec(codec_name, dsum[s:e], z)
+                            for s, e in _grid_bounds(size, chunk_bytes)
+                        ]
+                        y = (
+                            jnp.concatenate(parts) if len(parts) > 1
+                            else parts[0]
+                        )
+                    else:
+                        y = dsum
+                    # where(), not multiply-by-mask: a poisoned NaN
+                    # image on a non-egress rank must not leak through
+                    # NaN * 0
+                    contrib = jnp.where(is_egress, y, jnp.zeros_like(y))
+                    red = lax.psum(contrib, axis)
+                if op == ReduceOp.AVG:
+                    red = red / jnp.float32(n)
+                    red = _hardround(red, z) if _is_float(red.dtype) else red
+                outs.append(jnp.expand_dims(red, 0))
+            return tuple(outs)
+
+        mesh_mgr._note_trace()
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(),) + tuple(P(axis) for _ in stacked),
+            out_specs=tuple(P(axis) for _ in stacked),
+            check_rep=False,
+        )(z, *stacked)
+
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P(axis))
+    avals = [jax.ShapeDtypeStruct((), np.int32, sharding=rep)] + [
+        jax.ShapeDtypeStruct((n, size), np.dtype(dt), sharding=row)
+        for (size, dt) in layouts
+    ]
+    with _x64_trace():
+        return jax.jit(fn).lower(*avals).compile(), (rep, row)
+
+
+def _host_hier_allreduce(contribs: List[List[np.ndarray]],
+                         codec_name: str, chunk_bytes: int, op: str,
+                         groups: Sequence[Sequence[int]],
+                         world_size: int) -> List[np.ndarray]:
+    """Host simulation of the hierarchical composition, running the
+    REAL codec code over the real chunk grid — bitwise-identical to the
+    socket transport's hier path by construction. Serves the 64-bit
+    dtype fallback (like ``_host_allreduce``) AND doubles as THE
+    deterministic reference composition the bench's sha256 oracle
+    grades both planes against. Returns ONE result list (all ranks
+    decode identical values on the hier path)."""
+    codec = _CODECS[codec_name]()
+    reduce_fn = _REDUCE_FNS.get(ReduceOp.SUM if op == ReduceOp.AVG else op)
+    if reduce_fn is None:
+        raise ValueError(f"unsupported reduce op: {op}")
+    lossy = type(codec) is not _NoCodec
+    copy = lambda v, inc: np.copyto(v, inc)  # noqa: E731
+
+    # reduce-within: wire-rank order per domain (the intra star's order)
+    dsums: List[List[np.ndarray]] = []
+    for ranks in groups:
+        acc = [a.copy() for a in contribs[ranks[0]]]
+        acc_chunks = _chunk_grid([a.reshape(-1) for a in acc], chunk_bytes)
+        for r in ranks[1:]:
+            peer_chunks = _chunk_grid(
+                [a.reshape(-1) for a in contribs[r]], chunk_bytes
+            )
+            for ch, inc in zip(acc_chunks, peer_chunks):
+                reduce_fn(ch, inc)
+        dsums.append(acc)
+    # exchange-across: star fan-in over the domain tier (domain 0 raw,
+    # the rest encoded once), then the root's final re-encode
+    total = dsums[0]
+    total_chunks = _chunk_grid(
+        [a.reshape(-1) for a in total], chunk_bytes
+    )
+    for dsum in dsums[1:]:
+        d_chunks = _chunk_grid([a.reshape(-1) for a in dsum], chunk_bytes)
+        for ch, inc in zip(total_chunks, d_chunks):
+            codec.decode_into(
+                _iov_join(codec.encode_iovecs([inc])), [ch], reduce_fn
+            )
+    if len(dsums) > 1 and lossy:
+        for ch in total_chunks:
+            codec.decode_into(
+                _iov_join(codec.encode_iovecs([ch])), [ch], copy
+            )
+    if op == ReduceOp.AVG:
+        for a in total:
+            np.divide(a, world_size, out=a)
+    return total
+
+
 # ------------------------------------------------------ host-side fallback
 
 
@@ -941,17 +1198,20 @@ def _host_allreduce(contribs: List[List[np.ndarray]], algorithm: str,
 
 class _Sub:
     __slots__ = ("opcode", "arrays", "op", "root", "fut", "owners",
-                 "t_submit")
+                 "topology", "t_submit")
 
     def __init__(self, opcode: str, arrays: List[np.ndarray], op: str,
                  root: int, fut: Future,
-                 owners: "Optional[List[int]]" = None) -> None:
+                 owners: "Optional[List[int]]" = None,
+                 topology: "Optional[str]" = None) -> None:
         self.opcode = opcode
         self.arrays = arrays
         self.op = op
         self.root = root
         self.fut = fut
         self.owners = owners  # reduce_scatter: destination rank per array
+        # allreduce: per-op topology override (None = context default)
+        self.topology = topology
         self.t_submit = time.perf_counter()
 
 
@@ -1208,6 +1468,7 @@ class _XlaGroup:
         first = ordered[0]
         sig = [
             (sub.opcode, sub.op, sub.root, tuple(sub.owners or ()),
+             sub.topology,
              [(a.shape, _dtype_key(a.dtype)) for a in sub.arrays])
             for sub in ordered
         ]
@@ -1268,12 +1529,16 @@ class _XlaGroup:
         codec_name = ctx0._codec_name
         chunk_bytes = ctx0._chunk_bytes
         arrays0 = ordered[0].arrays
+        topo = ordered[0].topology or ctx0._topology_default
         # Op-dependent capability (the ctor vetted the static combo):
         # e.g. int8 psum with op='max' — per-chunk scales cannot ride a
         # max reduction. ONE definition (unsupported_reason) shared with
-        # Manager.comm_supports and the bench sweeps.
+        # Manager.comm_supports and the bench sweeps. Hier checks the
+        # RAW ctor algorithm (its "auto" resolves to star composition,
+        # not the flat path's world-size rule).
         reason = XlaCommContext.unsupported_reason(
-            algorithm, codec_name, op
+            ctx0._algorithm if topo == "hier" else algorithm,
+            codec_name, op, topo,
         )
         if reason is not None:
             raise ValueError(reason)
@@ -1287,6 +1552,9 @@ class _XlaGroup:
                 "ReduceOp.AVG requires float arrays (matching the host "
                 "transport, whose in-place integer divide raises)"
             )
+        if topo == "hier":
+            self._execute_hier(ordered, op)
+            return
         # REDUCE_SCATTER: same math, narrowed delivery. ``owners[j]`` is
         # the only rank whose copy of array j is written back (the
         # others stay unspecified — donation contract). Parity
@@ -1405,6 +1673,122 @@ class _XlaGroup:
                     continue
                 np.copyto(sub.arrays[j], host_results[r][k])
 
+    def _execute_hier(self, ordered: List[_Sub], op: str) -> None:
+        """Hierarchical allreduce over the domain tree: reduce-within →
+        compress → exchange-across → broadcast-within, as ONE cached
+        executable (the PR 6 pattern — a kill→reform at a seen (world,
+        codec, topology, domain-structure) key is a cache lookup, never
+        a retrace). Composition: the deterministic star fan-in
+        (bit-matching the host transport's hier path — THE parity arm,
+        bitwise at codec='none') or, for ``algorithm='psum'``, the
+        native grouped-psum tiers (numeric; XLA owns the order).
+        The ``comm_intra_bytes``/``comm_inter_bytes``/``comm_hops``
+        counters model the real tiered wire: raw full-precision bytes
+        inside a domain, encoded bytes for egress ranks only across
+        domains — the surface the hier path exists for."""
+        import jax
+
+        n = self.world_size
+        ctx0 = self._members[0]
+        codec_name = ctx0._codec_name
+        chunk_bytes = ctx0._chunk_bytes
+        arrays0 = ordered[0].arrays
+        assigns = [
+            self._members[r]._resolve_assignment() for r in range(n)
+        ]
+        fps = {a.fingerprint for a in assigns}
+        if len(fps) != 1:
+            raise ConnectionError(
+                "hier allreduce with divergent domain assignments "
+                f"across ranks: {sorted(fps)} — resolver maps must "
+                "match across the cohort"
+            )
+        a0 = assigns[0]
+        if a0.world_size() != n:
+            raise ConnectionError(
+                f"domain assignment spans {a0.world_size()} ranks but "
+                f"the wire has {n}"
+            )
+        hier_algo = ctx0._resolved_hier_algorithm()
+        groups = a0.groups
+
+        # Tier byte/hop accounting, per member, same convention as the
+        # host hier path (one direction, that rank's contribution).
+        raw_b = float(sum(a.nbytes for a in arrays0))
+        enc_b = float(sum(ctx0.wire_nbytes(a) for a in arrays0))
+        for r in range(n):
+            m = self._members[r].metrics
+            m_r = len(a0.group_of(r))
+            m.incr("comm_intra_bytes", raw_b if m_r > 1 else 0.0)
+            m.incr(
+                "comm_inter_bytes",
+                enc_b if (a0.is_egress(r) and a0.n_domains > 1) else 0.0,
+            )
+            # reduce-to-egress (1) + broadcast-within (1) + star
+            # fan-in (2) — the host hier path's hop model
+            hops = (2 if m_r > 1 else 0) + (2 if a0.n_domains > 1 else 0)
+            m.incr("comm_hops", float(hops))
+
+        dev_idx = [
+            j for j, a in enumerate(arrays0) if _is_device_dtype(a.dtype)
+        ]
+        host_idx = [j for j in range(len(arrays0)) if j not in dev_idx]
+        if host_idx:
+            host_result = _host_hier_allreduce(
+                [[sub.arrays[j] for j in host_idx] for sub in ordered],
+                codec_name, chunk_bytes, op, groups, n,
+            )
+        outs: List[Any] = []
+        if dev_idx:
+            layouts = tuple(
+                (int(arrays0[j].size), _dtype_key(arrays0[j].dtype))
+                for j in dev_idx
+            )
+            mm = self.mesh_mgr
+            if hier_algo == "psum":
+                key = (n, "hier_psum", codec_name, chunk_bytes, op,
+                       layouts, groups)
+                build = lambda: _build_hier_psum(  # noqa: E731
+                    mm, n, codec_name, chunk_bytes, op,
+                    [(s, np.dtype(d)) for (s, d) in layouts],
+                    groups, a0.egress,
+                )
+            else:
+                key = (n, "hier", codec_name, chunk_bytes, op, layouts,
+                       groups)
+                build = lambda: _build_hier_allreduce(  # noqa: E731
+                    mm, n, codec_name, chunk_bytes, op,
+                    [(s, np.dtype(d)) for (s, d) in layouts], groups,
+                )
+            compiled, (rep, row) = mm.executable(key, build)
+            n_chunks = float(sum(
+                len(_chunk_grid([arrays0[j].reshape(-1)], chunk_bytes))
+                for j in dev_idx
+            ))
+            for r in range(n):
+                self._members[r].metrics.incr("comm_chunks", n_chunks)
+            with _x64_trace():
+                ins = [jax.device_put(np.int32(0), rep)] + [
+                    jax.device_put(
+                        np.stack([
+                            np.ascontiguousarray(sub.arrays[j]).reshape(-1)
+                            for sub in ordered
+                        ]),
+                        row,
+                    )
+                    for j in dev_idx
+                ]
+            outs = [np.asarray(o) for o in compiled(*ins)]
+
+        for r, sub in enumerate(ordered):
+            for k, j in enumerate(dev_idx):
+                a = sub.arrays[j]
+                np.copyto(
+                    a.reshape(-1), outs[k][0].astype(a.dtype, copy=False)
+                )
+            for k, j in enumerate(host_idx):
+                np.copyto(sub.arrays[j], host_result[k])
+
     def _execute_psum_scatter(self, ordered: List[_Sub], op: str) -> None:
         """Hardware-native reduce_scatter: ``jax.lax.psum_scatter``
         inside shard_map, one cached executable per (world, sizes)
@@ -1494,11 +1878,15 @@ class XlaCommContext(CommContext):
                  algorithm: str = "auto",
                  compression: str = "none",
                  chunk_bytes: int = 1 << 20,
-                 mesh_manager: Optional[MeshManager] = None) -> None:
+                 mesh_manager: Optional[MeshManager] = None,
+                 topology: str = "flat",
+                 domain_resolver=None) -> None:
         super().__init__()
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
-        reason = self.unsupported_reason(algorithm, compression)
+        reason = self.unsupported_reason(
+            algorithm, compression, topology=topology
+        )
         if reason is not None:
             raise ValueError(reason)
         if chunk_bytes < 0:
@@ -1509,6 +1897,16 @@ class XlaCommContext(CommContext):
         self._codec = _CODECS[compression]()
         self._chunk_bytes = int(chunk_bytes)
         self._mesh_mgr = mesh_manager or default_mesh_manager()
+        # Default data path for allreduce ops ("flat"/"hier"; per-op
+        # override rides _Sub.topology). The domain resolver maps the
+        # cohort to tier structure at every world>1 configure — cheap
+        # cached dict work in process, so even a flat-default context
+        # can serve per-op hier ops (the bench's A/B lever).
+        self._topology_default = topology
+        self._domain_resolver = domain_resolver
+        self._wire_members: "Optional[List[str]]" = None
+        self._configured_members: "Optional[List[str]]" = None
+        self._hier_assignment = None
         self._group: Optional[_XlaGroup] = None
         self._seq = 0
         self._generation = 0
@@ -1520,20 +1918,40 @@ class XlaCommContext(CommContext):
 
     @classmethod
     def unsupported_reason(cls, algorithm: str, compression: str,
-                           op: str = ReduceOp.SUM) -> Optional[str]:
+                           op: str = ReduceOp.SUM,
+                           topology: str = "flat") -> Optional[str]:
         """THE xla-plane capability rule (CommContext surface): every
         codec runs on star/ring (the bitwise parity paths) for every
         reduce op; the hardware-native ``psum`` path carries every codec
         too (the quantized exchange — EQuARX) but a LOSSY codec only
         accumulates: per-chunk scales cannot ride a max/min reduction,
         so that combo gets a prescriptive error instead of silently
-        wrong extrema."""
+        wrong extrema. ``topology="hier"`` composes the domain tree on
+        this plane as star fan-in (the deterministic parity builder) or
+        the native grouped-psum exchange — the multi-hop RING inter
+        tier is a host-plane arm, refused prescriptively here."""
         if algorithm not in ("auto", "star", "ring", "psum"):
             return f"unknown algorithm {algorithm!r}"
         if compression not in _CODECS:
             return (
                 f"unknown compression {compression!r}; have "
                 f"{sorted(_CODECS)}"
+            )
+        if topology not in ("flat", "hier"):
+            return (
+                f"unknown topology {topology!r}; have 'flat' (one tier "
+                "spanning the wire) and 'hier' (domain tree: "
+                "reduce-within -> compress -> exchange-across -> "
+                "broadcast-within)"
+            )
+        if topology == "hier" and algorithm == "ring":
+            return (
+                "topology='hier' with algorithm='ring' is the multi-hop "
+                "cross-domain rotation, a host-plane arm (comm_backend="
+                "'host'); the xla hier path composes star fan-in or the "
+                "native grouped psum — use algorithm='star'/'auto'/"
+                "'psum' here, or select the host backend for the ring "
+                "inter tier"
             )
         if (
             algorithm == "psum"
@@ -1567,10 +1985,51 @@ class XlaCommContext(CommContext):
         self._events = events
         self._mesh_mgr.events = events
 
+    def set_wire_members(self, members: "Sequence[str]") -> None:
+        """Replica ids of the upcoming cohort in transport rank order
+        (Manager-fed, pre-configure) — what the domain resolver maps to
+        tier structure; ``rank{r}`` names are synthesized without it
+        (so ``TORCHFT_TPU_DOMAINS`` maps can address bench ranks)."""
+        self._wire_members = [str(m) for m in members]
+
+    def set_domain_resolver(self, resolver) -> None:
+        """Install a DomainTopology unless the ctor already provided
+        one (explicit wins) — the Manager wires a resolver homed to the
+        job's lighthouse ``/status.json`` here, so a managed hier job
+        needs zero topology plumbing."""
+        if self._domain_resolver is None:
+            self._domain_resolver = resolver
+
+    def _resolve_assignment(self):
+        """The cohort's DomainAssignment, resolved at most once per
+        configure (cached): eagerly for hier-default contexts, lazily
+        from the first per-op hier op otherwise."""
+        if self._hier_assignment is not None:
+            return self._hier_assignment
+        members = getattr(self, "_configured_members", None)
+        if members is None:
+            raise RuntimeError(
+                "hier allreduce before configure: the cohort is unknown"
+            )
+        resolver = self._domain_resolver
+        if resolver is None:
+            from torchft_tpu.comm.topology import DomainTopology
+
+            resolver = self._domain_resolver = DomainTopology()
+        self._hier_assignment = resolver.assign(members)
+        return self._hier_assignment
+
     def _resolved_algorithm(self, world_size: int) -> str:
         if self._algorithm == "auto":
             return "ring" if world_size >= 3 else "star"
         return self._algorithm
+
+    def _resolved_hier_algorithm(self) -> str:
+        """The hier path's composition: "psum" stays native (grouped
+        psum tiers); everything else — including "auto" at ANY world
+        size — is the deterministic star fan-in (the host hier's
+        composition, hence the bitwise-parity arm)."""
+        return "psum" if self._algorithm == "psum" else "star"
 
     # ------------------------------------------------------------ lifecycle
 
@@ -1602,6 +2061,23 @@ class XlaCommContext(CommContext):
         # cache lookup for any previously-seen world size.
         key = store_addr
         self._mesh_mgr.mesh_for(world_size)
+        # Pin the cohort for domain resolution. A hier-DEFAULT context
+        # resolves eagerly (the tier structure is this configure's
+        # contract, and a live /status.json resolver should pay its
+        # walk at the quorum boundary, not mid-op); a flat-default
+        # context resolves LAZILY on its first per-op hier op, so flat
+        # jobs never touch the resolver at all.
+        self._configured_members = (
+            self._wire_members
+            if self._wire_members is not None
+            and len(self._wire_members) == world_size
+            else [f"rank{r}" for r in range(world_size)]
+        )
+        self._hier_assignment = None
+        assignment = (
+            self._resolve_assignment()
+            if self._topology_default == "hier" else None
+        )
         group = _XlaGroup.join(key, rank, world_size, self, self._timeout)
         with self._lock:
             self._group = group
@@ -1613,6 +2089,16 @@ class XlaCommContext(CommContext):
                 generation=generation,
                 algorithm=self._resolved_algorithm(world_size),
             )
+            if assignment is not None:
+                # configure-rate plan anchor, same as the host plane
+                ev.emit(
+                    "hier_exchange", world=world_size,
+                    domains=assignment.n_domains,
+                    egress=list(assignment.egress),
+                    domain=assignment.domains[rank],
+                    is_egress=assignment.is_egress(rank),
+                    fingerprint=assignment.fingerprint,
+                )
 
     def shutdown(self) -> None:
         with self._lock:
@@ -1678,6 +2164,17 @@ class XlaCommContext(CommContext):
             rank = self._rank
         if self._codec_name == "none" or world <= 1:
             return False
+        if self._topology_default == "hier":
+            # codec bytes exist only on the cross-domain tier: an
+            # EGRESS rank's domain sum is what gets encoded. Star
+            # fan-in leaves domain 0's sum raw (the inter root), the
+            # native grouped psum encodes EVERY domain's sum.
+            a = self._hier_assignment
+            if a is None or a.n_domains <= 1 or not a.is_egress(rank):
+                return False
+            if self._resolved_hier_algorithm() == "psum":
+                return True
+            return a.domain_index(rank) != 0
         algo = self._resolved_algorithm(world)
         return (algo == "star" and rank != 0) or algo == "psum"
 
@@ -1702,7 +2199,8 @@ class XlaCommContext(CommContext):
 
     def _submit(self, opcode: str, arrays: Sequence[np.ndarray], op: str,
                 root: int,
-                owners: "Optional[Sequence[int]]" = None) -> Work:
+                owners: "Optional[Sequence[int]]" = None,
+                topology: "Optional[str]" = None) -> Work:
         fut: Future = Future()
         fut.set_running_or_notify_cancel()
         err = self.errored()
@@ -1735,15 +2233,36 @@ class XlaCommContext(CommContext):
             _Sub(
                 opcode, prepared, op, root, fut,
                 owners=None if owners is None else [int(o) for o in owners],
+                topology=topology,
             ),
             self._timeout,
         )
         return Work(fut)
 
     def allreduce(
-        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,
+        topology: Optional[str] = None,
     ) -> Work:
-        return self._submit("allreduce", arrays, op, 0)
+        if (
+            topology is not None
+            and topology != self._topology_default
+            and self._codec_name != "none"
+        ):
+            # Same rule as the host plane: EF roles (wire_compensable)
+            # follow the DEFAULT topology, so a lossy per-op override
+            # would bank residuals against a wire the op never rode.
+            fut: Future = Future()
+            fut.set_running_or_notify_cancel()
+            fut.set_exception(ValueError(
+                f"per-op topology={topology!r} differs from this "
+                f"context's default {self._topology_default!r} under "
+                f"the lossy {self._codec_name!r} codec — construct a "
+                f"context with topology={topology!r} for this arm, or "
+                "use compression='none' for a per-op A/B (the "
+                "error-feedback roles follow the default topology)"
+            ))
+            return Work(fut)
+        return self._submit("allreduce", arrays, op, 0, topology=topology)
 
     def reduce_scatter(
         self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,

@@ -302,7 +302,8 @@ class DistributedDataParallel:
     def __init__(self, manager, bucket_bytes: int = _DEFAULT_BUCKET_BYTES,
                  error_feedback: "bool | str" = "auto",
                  staging_arenas: int = 2,
-                 streamed: bool = True) -> None:
+                 streamed: bool = True,
+                 topology: "Optional[str]" = None) -> None:
         if error_feedback not in (True, False, "auto"):
             raise ValueError(
                 f"error_feedback must be True/False/'auto', "
@@ -314,6 +315,14 @@ class DistributedDataParallel:
         self._bucket_bytes = bucket_bytes
         self._error_feedback = error_feedback
         self._streamed = bool(streamed)
+        # Per-op data-path selector forwarded to every bucket's
+        # allreduce ("flat"/"hier"; None = the comm context's own
+        # default, and the kwarg is then not even passed — mock/legacy
+        # managers without it keep working).
+        self._topology = topology
+        self._ar_kwargs = {} if topology is None else {
+            "topology": topology
+        }
         self._plan: "Optional[_BucketPlan]" = None
         self._arenas = [_Arena() for _ in range(int(staging_arenas))]
         self._plan_lock = threading.Lock()
@@ -588,7 +597,9 @@ class DistributedDataParallel:
                         )
                     )
                 submit_t[k] = time.perf_counter()
-                work = self._manager.allreduce_arrays([packed])
+                work = self._manager.allreduce_arrays(
+                    [packed], **self._ar_kwargs
+                )
                 landed: Future = Future()
                 landed.set_running_or_notify_cancel()
                 group.add(landed)
@@ -682,7 +693,9 @@ class DistributedDataParallel:
                     np.add(packed, res, out=packed)
                     self._ef_residual(packed, res, metrics)
                 submit_t[k] = time.perf_counter()
-                work = self._manager.allreduce_arrays([packed])
+                work = self._manager.allreduce_arrays(
+                    [packed], **self._ar_kwargs
+                )
                 works.append(work)
                 if metrics is not None:
                     # Same per-bucket wire observability as the streamed

@@ -180,7 +180,8 @@ class LocalSGD:
                  num_fragments: int = 1,
                  streaming: bool = True,
                  error_feedback: "bool | str" = "auto",
-                 sharded_outer: bool = False) -> None:
+                 sharded_outer: bool = False,
+                 topology: "Optional[str]" = None) -> None:
         """``params_fn``: zero-arg callable returning the CURRENT params —
         the same state the Manager's user ``load_state_dict`` writes into.
         Needed for heal: params here are caller-owned values, so after a
@@ -225,6 +226,15 @@ class LocalSGD:
                 f"got {error_feedback!r}"
             )
         self._manager = manager
+        # Outer-sync data-path selector ("flat"/"hier"; None = the comm
+        # context's default, and the kwarg is then not passed at all so
+        # stub/legacy managers keep working). The hierarchical tier is
+        # the natural outer-sync wire: pseudogradients are exactly the
+        # heavy, lossy-codec-friendly cross-DCN traffic DynamiQ tiers.
+        self._topology = topology
+        self._ar_kwargs = {} if topology is None else {
+            "topology": topology
+        }
         self._sync_every = sync_every
         self._params_fn = params_fn
         self._num_fragments = int(num_fragments)
@@ -748,7 +758,7 @@ class LocalSGD:
                 [arena], owners=[self._frag_owner(rnd, f)]
             )
         else:
-            work = mgr.allreduce_arrays([arena])
+            work = mgr.allreduce_arrays([arena], **self._ar_kwargs)
         landed: Future = Future()
         landed.set_running_or_notify_cancel()
         rnd.group.add(landed)
@@ -943,11 +953,13 @@ class DiLoCo(LocalSGD):
                  num_fragments: int = 1,
                  streaming: bool = True,
                  error_feedback: "bool | str" = "auto",
-                 sharded_outer: bool = False) -> None:
+                 sharded_outer: bool = False,
+                 topology: "Optional[str]" = None) -> None:
         super().__init__(
             manager, sync_every, params_fn=params_fn,
             num_fragments=num_fragments, streaming=streaming,
             error_feedback=error_feedback, sharded_outer=sharded_outer,
+            topology=topology,
         )
         self._outer = PartitionedOuterOptimizer(outer_tx)
 

@@ -369,6 +369,23 @@ class Manager:
         )
         if callable(ckpt_set_metrics):
             ckpt_set_metrics(self.metrics)
+        # Domain discovery for the hierarchical data plane: home the
+        # comm's DomainTopology to the job's lighthouse /status.json
+        # (the PR 10 domain tree) unless the caller already installed a
+        # resolver. Read through the env on EVERY rank — the wire
+        # cohort at intra-rank k spans rank-k processes, which never
+        # own the (rank-0-only) ManagerServer handle. Flat-topology
+        # contexts store the resolver but never consult it, so this
+        # costs nothing unless hier is actually selected.
+        set_resolver = getattr(comm, "set_domain_resolver", None)
+        if callable(set_resolver):
+            lh_addr = self._lighthouse_addr or os.environ.get(
+                LIGHTHOUSE_ENV
+            )
+            if lh_addr:
+                from torchft_tpu.comm.topology import DomainTopology
+
+                set_resolver(DomainTopology(status_url=lh_addr))
         # Share the flight recorder the same way: the transport emits
         # error_latched (and the xla backend mesh_reconfigure /
         # mesh_compile) into the one ring this process serves.
@@ -411,7 +428,8 @@ class Manager:
     # ------------------------------------------------------------ collectives
 
     def allreduce_arrays(
-        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM
+        self, arrays: Sequence[np.ndarray], op: str = ReduceOp.SUM,
+        topology: Optional[str] = None,
     ) -> Work:
         """Fault-tolerant cross-replica allreduce of host arrays, scaled by
         1/num_participants (ref manager.py:242-303 semantics):
@@ -420,6 +438,11 @@ class Manager:
         * while healing / not participating, contributes zeros
         * transport errors are latched, never raised — the future always
           completes (with the corrupt-but-unused input as the default)
+
+        ``topology`` selects the data path per op ("flat"/"hier" — the
+        hierarchical domain tree, comm/topology.py); ``None`` rides the
+        comm context's own default and is forwarded to nothing, so
+        legacy/test contexts without the parameter keep working.
 
         Buffer ownership: the caller DONATES ``arrays`` — the transport
         reduces in place, so the future may resolve to the very arrays
@@ -464,7 +487,12 @@ class Manager:
             # Reduce as SUM and apply the participant scaling below — the
             # same 1/num_participants the SUM path uses (ref manager.py:287).
             transport_op = ReduceOp.SUM if op == ReduceOp.AVG else op
-            work = self._comm.allreduce(arrays, transport_op)
+            if topology is None:
+                work = self._comm.allreduce(arrays, transport_op)
+            else:
+                work = self._comm.allreduce(
+                    arrays, transport_op, topology=topology
+                )
 
             def _normalize(f: Future) -> List[np.ndarray]:
                 self.metrics.observe(
@@ -906,6 +934,14 @@ class Manager:
                 f"wire={fingerprint} in_transport={in_transport} "
                 f"store={store_prefixed_addr}"
             )
+            # Hand the cohort (replica ids in transport rank order) to
+            # the data plane BEFORE configure: the hierarchical tier's
+            # domain resolver maps these onto the lighthouse domain
+            # tree (comm/topology.py). Getattr-guarded like set_metrics
+            # — flat-only and legacy contexts have no use for it.
+            set_members = getattr(self._comm, "set_wire_members", None)
+            if callable(set_members) and quorum.transport_replica_ids:
+                set_members(list(quorum.transport_replica_ids))
             try:
                 self._comm.configure(store_prefixed_addr, t_rank, t_world)
                 self._transport_key = transport_key
@@ -1221,24 +1257,43 @@ class Manager:
         return int(np.asarray(a).nbytes)
 
     def comm_unsupported_reason(
-        self, algorithm: str, compression: str, op: str = ReduceOp.SUM
+        self, algorithm: str, compression: str, op: str = ReduceOp.SUM,
+        topology: str = "flat",
     ) -> Optional[str]:
         """Capability query against the active data plane (ONE shared
         definition per backend — CommContext.unsupported_reason): None
         when the combo runs, else a prescriptive error string. Contexts
-        predating the surface support everything they construct with."""
+        predating the surface support everything they construct with;
+        the default ``topology="flat"`` is passed positionally-omitted
+        so their three-argument signatures keep working."""
         fn = getattr(self._comm, "unsupported_reason", None)
-        if callable(fn):
+        if not callable(fn):
+            return None
+        if topology == "flat":
             return fn(algorithm, compression, op)
-        return None
+        try:
+            return fn(algorithm, compression, op, topology)
+        except TypeError:
+            # a context predating the topology parameter: answer the
+            # query prescriptively instead of crashing the probe
+            return (
+                f"this comm context ({type(self._comm).__name__}) "
+                "predates the topology dimension — only the flat tier "
+                "exists here; use a TcpCommContext/XlaCommContext for "
+                f"topology={topology!r}"
+            )
 
     def comm_supports(
-        self, algorithm: str, compression: str, op: str = ReduceOp.SUM
+        self, algorithm: str, compression: str, op: str = ReduceOp.SUM,
+        topology: str = "flat",
     ) -> bool:
         """True when the active data plane can run ``algorithm`` with
-        ``compression`` for ``op`` (e.g. quantized psum: xla yes for
-        sum/avg, host never)."""
-        return self.comm_unsupported_reason(algorithm, compression, op) is None
+        ``compression`` for ``op`` over ``topology`` (e.g. quantized
+        psum: xla yes for sum/avg, host never; hier ring inter: host
+        yes, xla never)."""
+        return self.comm_unsupported_reason(
+            algorithm, compression, op, topology
+        ) is None
 
     def transport_world_size(self) -> int:
         """Members of the gradient wire for the current quorum (data-plane

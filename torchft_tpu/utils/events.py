@@ -26,6 +26,11 @@ Event vocabulary (producers in parentheses):
     mesh_reconfigure / mesh_compile  (comm/xla_backend.py: device mesh
                                       rebuilt for a new world size / an
                                       executable actually compiled)
+    hier_exchange                    (comm/transport.py /
+                                      comm/xla_backend.py: a hierarchical
+                                      exchange plan installed for a
+                                      cohort — domains, egress ranks,
+                                      assignment fingerprint)
     shard_grid_rebuild               (ddp.py: the sharded-update leaf
                                       grid rebuilt for a new wire world
                                       size — old/new worlds attached)
@@ -85,6 +90,7 @@ EVENT_KINDS = (
     "member_dead",
     "mesh_reconfigure",
     "mesh_compile",
+    "hier_exchange",
     "shard_grid_rebuild",
     "reshard",
 )
