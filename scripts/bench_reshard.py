@@ -1,0 +1,298 @@
+#!/usr/bin/env python
+"""Rep-interleaved A/B for the redistribution engine (ISSUE 14).
+
+Two reshard-exchange arms over the SAME transitions, real TCP loopback
+wire, thread per rank:
+
+  plan       the redistribution engine: holdings-metadata allgather →
+             cached minimal transfer plan → point-to-point fetches of
+             exactly the leaf states whose owner changed
+             (``ShardedOptimizerWrapper(redistribute="plan")``)
+  allgather  the legacy PR 8 exchange: every departing leaf state
+             allgathered to the WHOLE cohort, receivers pick what they
+             need (``redistribute="allgather"`` — the live A/B lever)
+
+Transitions swept (each a seeded source-world run whose optimizer
+states are carried into a destination-world continuation):
+
+  grow       w2→w3, w3→w4   (a fresh joiner; survivors' shards shift)
+  shrink     w3→w2, w4→w3   (a rank dies with its shard — the moved
+                             bytes exclude the unavoidable reinit slice)
+  rebalance  w3→w3 rotated  (same world, every shard moves one rank)
+
+Arms alternate per rep (odd reps swap order) with a warmup pair first,
+gc collected OUTSIDE the timed windows, and the bitwise oracle checked
+EVERY rep: the planned arm's post-step params AND per-rank held leaf
+states must equal the legacy arm's bit for bit (same states moved,
+different wire).
+
+What is graded is COUNTER-based (the honest sandbox methodology —
+ROADMAP re-anchor note): per-rank ``redist_moved_bytes`` — bytes the
+exchange actually RECEIVED — against ``redist_lower_bound_bytes``, the
+set-theoretic minimum. The planned arm must pin moved == lower bound
+on every rank of every transition; the legacy arm's moved/lower ratio
+IS the avoidable waste. Plan-cache behavior is pinned too (second rep
+of a transition = 0 new builds). Wall time is reported as a secondary,
+noise-qualified number — on this 2-core loopback sandbox the wire is a
+memcpy and both arms' exchanges are sub-ms; the byte counters are the
+win this path exists for on real DCN.
+
+  python scripts/bench_reshard.py --reps 3 --out out.json
+"""
+
+import argparse
+import copy
+import gc
+import hashlib
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def make_params(n_leaves, leaf_elems, seed=7):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return {
+        f"w{i:02d}": rng.standard_normal(
+            leaf_elems + 3 * i
+        ).astype(np.float32)
+        for i in range(n_leaves)
+    }
+
+
+def seed_states(store, world, prefix, params0, steps=2):
+    """A source-world run whose final per-rank states the transitions
+    carry (deep-copied per rep/arm — runs mutate them)."""
+    import numpy as np
+    import optax
+
+    import jax
+    import jax.numpy as jnp
+    from torchft_tpu.comm.transport import TcpCommContext
+    from torchft_tpu.comm.wire_stub import run_stub_ranks
+    from torchft_tpu.optim import ShardedOptimizerWrapper
+
+    def _fn(mgr, rank):
+        opt = ShardedOptimizerWrapper(mgr, optax.adamw(1e-3), sharded=True)
+        params = jax.tree_util.tree_map(jnp.asarray, params0)
+        state = opt.init(params)
+        for s in range(steps):
+            mgr.start_quorum()
+            grads = jax.tree_util.tree_map(
+                lambda x: x * np.float32(0.01 * (rank + 1) * (s + 1)),
+                params,
+            )
+            params, state, ok = opt.step(params, state, grads)
+            if not ok:
+                raise RuntimeError("seed step discarded")
+        return state
+
+    return run_stub_ranks(
+        store.addr, prefix, world, _fn,
+        lambda: TcpCommContext(timeout=30.0), timeout=180,
+    )
+
+
+def run_transition(store, prefix, mode, carried, world, params0,
+                   planners=None):
+    """One destination-world continuation step through one exchange
+    arm. Returns per-rank counters + a digest of (params, held
+    states) for the cross-arm bitwise oracle."""
+    import numpy as np
+    import optax
+
+    import jax
+    import jax.numpy as jnp
+    from torchft_tpu.comm.transport import TcpCommContext
+    from torchft_tpu.comm.wire_stub import run_stub_ranks
+    from torchft_tpu.optim import ShardedOptimizerWrapper
+
+    def _fn(mgr, rank):
+        opt = ShardedOptimizerWrapper(
+            mgr, optax.adamw(1e-3), sharded=True, redistribute=mode,
+            planner=None if planners is None else planners[rank],
+        )
+        params = jax.tree_util.tree_map(jnp.asarray, params0)
+        state = (
+            copy.deepcopy(carried[rank])
+            if carried[rank] is not None else opt.init(params)
+        )
+        mgr.start_quorum()
+        grads = jax.tree_util.tree_map(
+            lambda x: x * np.float32(0.02 * (rank + 1)), params
+        )
+        t0 = time.perf_counter()
+        params, state, ok = opt.step(params, state, grads)
+        jax.block_until_ready(jax.tree_util.tree_leaves(params))
+        wall = time.perf_counter() - t0
+        if not ok:
+            raise RuntimeError("transition step discarded")
+        snap = mgr.metrics.snapshot()
+        sha = hashlib.sha256()
+        for leaf in jax.tree_util.tree_leaves(params):
+            sha.update(np.asarray(leaf).tobytes())
+        for i in state.held():
+            for a in jax.tree_util.tree_leaves(state.leaf_states[i]):
+                sha.update(np.asarray(a).tobytes())
+        ev, _, _ = mgr.events.since(0)
+        resh = [e for e in ev if e["kind"] == "reshard"]
+        return {
+            "moved": float(snap.get("redist_moved_bytes") or 0.0),
+            "lower": float(snap.get("redist_lower_bound_bytes") or 0.0),
+            "reinit": sum(e.get("reinit_leaves") or 0 for e in resh),
+            "wall_ms": wall * 1000.0,
+            "sha": sha.hexdigest(),
+        }
+
+    return run_stub_ranks(
+        store.addr, prefix, world, _fn,
+        lambda: TcpCommContext(timeout=30.0), timeout=180,
+    )
+
+
+def rotate_carry(states, world):
+    """Rebalance source: rank r carries rank (r+1)%w's shard — same
+    world, every shard moves one rank at the exchange."""
+    return [states[(r + 1) % world] for r in range(world)]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--leaves", type=int, default=16)
+    ap.add_argument("--leaf-elems", type=int, default=2048)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from torchft_tpu.comm.redistribute import RedistPlanner
+    from torchft_tpu.comm.store import StoreServer
+
+    params0 = make_params(args.leaves, args.leaf_elems)
+    store = StoreServer()
+    seeds = {
+        w: seed_states(store, w, f"seed_w{w}", params0)
+        for w in (2, 3, 4)
+    }
+    transitions = [
+        ("grow_w2_w3", [seeds[2][0], seeds[2][1], None], 3),
+        ("grow_w3_w4",
+         [seeds[3][0], seeds[3][1], seeds[3][2], None], 4),
+        ("shrink_w3_w2", [seeds[3][0], seeds[3][1]], 2),
+        ("shrink_w4_w3", [seeds[4][0], seeds[4][1], seeds[4][2]], 3),
+        ("rebalance_w3", rotate_carry(seeds[3], 3), 3),
+    ]
+
+    results = []
+    ok = True
+    for name, carried, world in transitions:
+        planners = [RedistPlanner() for _ in range(world)]
+        reps = []
+        # warmup pair (also primes the plan cache — later reps pin it)
+        run_transition(store, f"{name}_wu_p", "plan", carried, world,
+                       params0, planners=planners)
+        run_transition(store, f"{name}_wu_l", "allgather", carried,
+                       world, params0)
+        builds_after_warmup = [p.builds for p in planners]
+        for rep in range(args.reps):
+            arms = ["plan", "allgather"]
+            if rep % 2:
+                arms.reverse()
+            gc.collect()
+            gc.disable()
+            try:
+                out = {}
+                for arm in arms:
+                    out[arm] = run_transition(
+                        store, f"{name}_r{rep}_{arm}", arm, carried,
+                        world, params0,
+                        planners=planners if arm == "plan" else None,
+                    )
+            finally:
+                gc.enable()
+            bitwise = all(
+                out["plan"][r]["sha"] == out["allgather"][r]["sha"]
+                for r in range(world)
+            )
+            if not bitwise:
+                ok = False
+            entry = {
+                "rep": rep,
+                "order": arms,
+                "bitwise": bitwise,
+                "plan": {
+                    "moved": sum(r["moved"] for r in out["plan"]),
+                    "lower": sum(r["lower"] for r in out["plan"]),
+                    "wall_ms": [r["wall_ms"] for r in out["plan"]],
+                },
+                "allgather": {
+                    "moved": sum(r["moved"] for r in out["allgather"]),
+                    "lower": sum(r["lower"] for r in out["allgather"]),
+                    "wall_ms": [r["wall_ms"] for r in out["allgather"]],
+                },
+            }
+            # the acceptance pins: planned moved == lower EVERY rank
+            entry["plan"]["minimal"] = all(
+                r["moved"] == r["lower"] for r in out["plan"]
+            )
+            if not entry["plan"]["minimal"]:
+                ok = False
+            reps.append(entry)
+            print(json.dumps({"transition": name, **entry}), flush=True)
+        cache_clean = [p.builds for p in planners] == builds_after_warmup
+        if not cache_clean:
+            ok = False
+        lower = reps[0]["plan"]["lower"]
+        legacy_moved = sum(
+            r["allgather"]["moved"] for r in reps
+        ) / len(reps)
+        results.append({
+            "transition": name,
+            "world": world,
+            "reps": reps,
+            "plan_cache_zero_builds_after_warmup": cache_clean,
+            "lower_bound_total": lower,
+            "legacy_moved_avg": legacy_moved,
+            "legacy_over_lower_ratio": (
+                legacy_moved / lower if lower else None
+            ),
+        })
+    store.shutdown()
+
+    summary = {
+        "metric": "bench_reshard_ab",
+        "reps": args.reps,
+        "leaves": args.leaves,
+        "leaf_elems": args.leaf_elems,
+        "transitions": results,
+        "ok": ok,
+        "note": (
+            "counter-graded: planned arm pins redist_moved_bytes == "
+            "redist_lower_bound_bytes per rank per transition; "
+            "legacy_over_lower_ratio is the allgather arm's avoidable "
+            "waste. Wall time is an honest NULL-TO-NEGATIVE on this "
+            "2-core loopback sandbox: the planned arm pays an "
+            "ephemeral HTTP endpoint spin-up + manifest round trip "
+            "per exchange while the legacy arm's broadcast rides a "
+            "memcpy-speed loopback wire — the structural win is bytes "
+            "on a bandwidth-bound DCN link, which is what the "
+            "counters pin (transitions are membership-change-rate, "
+            "not step-rate, so the fixed overhead amortizes to zero "
+            "in training time either way)."
+        ),
+    }
+    line = json.dumps(summary)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -663,6 +663,103 @@ def sharded_smoke() -> "list[str]":
     return failures
 
 
+def redist_smoke() -> "list[str]":
+    """One in-process w2→w3 grow through the planned redistribution
+    exchange (the ISSUE 14 gate): fails on missing/non-finite redist
+    gauges, moved_bytes > lower_bound_bytes (the plan over-shipped),
+    zero bytes moved (the grow tested nothing), or a plan-cache miss
+    on the second identical transition (the spec-pair cache
+    regressed)."""
+    import copy
+    import math
+
+    import numpy as np
+    import optax
+
+    import jax
+    import jax.numpy as jnp
+    from torchft_tpu.comm.redistribute import RedistPlanner
+    from torchft_tpu.comm.store import StoreServer
+    from torchft_tpu.comm.transport import TcpCommContext
+    from torchft_tpu.comm.wire_stub import run_stub_ranks
+    from torchft_tpu.optim import ShardedOptimizerWrapper
+
+    failures: "list[str]" = []
+    store = StoreServer()
+    rng = np.random.default_rng(11)
+    params0 = {
+        f"w{i}": rng.standard_normal(96 + 8 * i).astype(np.float32)
+        for i in range(6)
+    }
+
+    def _run(prefix, world, carried=None, planners=None):
+        def _fn(mgr, rank):
+            opt = ShardedOptimizerWrapper(
+                mgr, optax.adam(1e-2), sharded=True,
+                planner=None if planners is None else planners[rank],
+            )
+            params = jax.tree_util.tree_map(jnp.asarray, params0)
+            state = (
+                copy.deepcopy(carried[rank])
+                if carried is not None and carried[rank] is not None
+                else opt.init(params)
+            )
+            mgr.start_quorum()
+            grads = jax.tree_util.tree_map(lambda x: x * 0.1, params)
+            params, state, ok = opt.step(params, state, grads)
+            if not ok:
+                raise RuntimeError("redist smoke step discarded")
+            return state, mgr.metrics.snapshot()
+
+        return run_stub_ranks(
+            store.addr, prefix, world, _fn,
+            lambda: TcpCommContext(timeout=15.0), timeout=90,
+        )
+
+    try:
+        w2 = _run("redist_w2", 2)
+        planners = [RedistPlanner() for _ in range(3)]
+        carried = [w2[0][0], w2[1][0], None]
+        grown = _run("redist_w3a", 3, carried=carried, planners=planners)
+        total_moved = 0.0
+        for rank, (_, snap) in enumerate(grown):
+            for key in ("redist_plan_builds", "redist_moved_bytes",
+                        "redist_lower_bound_bytes"):
+                v = snap.get(key)
+                if v is None or not math.isfinite(float(v)) or v < 0:
+                    failures.append(
+                        f"redist smoke: gauge {key!r} missing/non-finite "
+                        f"on rank {rank}: {v!r}"
+                    )
+            moved = float(snap.get("redist_moved_bytes") or 0)
+            lower = float(snap.get("redist_lower_bound_bytes") or 0)
+            if moved != lower:
+                failures.append(
+                    f"redist smoke: rank {rank} moved {moved} != lower "
+                    f"bound {lower} — the planned exchange over-shipped"
+                )
+            total_moved += moved
+        if not failures and total_moved <= 0:
+            failures.append(
+                "redist smoke: the w2→w3 grow moved zero bytes — the "
+                "transition exercised nothing"
+            )
+        builds_first = [p.builds for p in planners]
+        _run("redist_w3b", 3, carried=carried, planners=planners)
+        for rank, p in enumerate(planners):
+            if p.builds != builds_first[rank]:
+                failures.append(
+                    f"redist smoke: rank {rank} recompiled a seen spec "
+                    f"pair on the second identical transition "
+                    f"(builds {builds_first[rank]} -> {p.builds})"
+                )
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"redist smoke: {e!r}")
+    finally:
+        store.shutdown()
+    return failures
+
+
 def fleet_smoke() -> "list[str]":
     """One in-process 32-group control-plane sweep point (the ISSUE 10
     gate): real HTTP against a live cached-quorum lighthouse plus the
@@ -770,6 +867,7 @@ def main() -> int:
     failures += hier_smoke()
     failures += events_smoke()
     failures += sharded_smoke()
+    failures += redist_smoke()
     failures += fleet_smoke()
     for key in ("t1_pipeline_overlap", "t1_pipeline_ms", "t1_ddp_streamed",
                 "t1_overhead_ms", "t1_outer_overlap", "t1_outer_wire_ms",
@@ -827,7 +925,8 @@ def main() -> int:
         f"events_recorded={payload.get('t1_events_recorded')} "
         f"opt_state_ratio={(payload.get('sharded') or {}).get('state_bytes_ratio')} "
         "heal_gauges=ok outer_gauges=ok xla_gauges=ok qpsum_gauges=ok "
-        "hier_gauges=ok chrome_trace=ok sharded_gauges=ok fleet_gauges=ok"
+        "hier_gauges=ok chrome_trace=ok sharded_gauges=ok "
+        "redist_gauges=ok fleet_gauges=ok"
     )
     return 0
 

@@ -178,6 +178,7 @@ def build_row(ep: Dict[str, Any],
         "outer_overlap": None,
         "d_intra_mb": None,
         "d_inter_mb": None,
+        "redist_waste_mb": None,
         "last_event": "-",
         "error": error,
     }
@@ -200,6 +201,15 @@ def build_row(ep: Dict[str, Any],
     if wt and we is not None:
         row["ddp_overlap"] = max(0.0, min(1.0, 1.0 - we / wt))
     row["outer_overlap"] = m.get("outer_overlap")
+    # Redistribution waste: cumulative bytes reshard/heal exchanges
+    # received BEYOND the set-theoretic minimum — 0 on planned
+    # transfers, the legacy allgather arm's avoidable broadcast
+    # otherwise (ISSUE 14: the postmortem number for "what did this
+    # membership churn cost that it didn't have to").
+    moved = m.get("redist_moved_bytes")
+    lower = m.get("redist_lower_bound_bytes")
+    if moved is not None and lower is not None:
+        row["redist_waste_mb"] = max(0.0, float(moved) - float(lower)) / 1e6
     counters = {
         k: float(m[k])
         for k in ("comm_intra_bytes", "comm_inter_bytes")
@@ -227,7 +237,7 @@ _COLUMNS = (
     ("replica", 34), ("rank", 4), ("step", 6), ("epoch", 5),
     ("committed", 9), ("discarded", 9), ("allreduce_p50_ms", 16),
     ("heal_mb_s", 9), ("ddp_overlap", 11), ("outer_overlap", 13),
-    ("d_intra_mb", 10), ("d_inter_mb", 10),
+    ("d_intra_mb", 10), ("d_inter_mb", 10), ("redist_waste_mb", 15),
     ("last_event", 34),
 )
 

@@ -15,14 +15,19 @@
 #   BENCH_SMOKE=1 scripts/test.sh  # one short bench.py window + one tiny
 #                                  # heal round + one streaming-DiLoCo round
 #                                  # + one xla allreduce round + one
-#                                  # flight-recorder round; asserts the
+#                                  # flight-recorder round + one w2→w3
+#                                  # redistribution grow; asserts the
 #                                  # streamed-pipeline, heal_*, outer_* and
 #                                  # backend-tagged comm_* gauges are present
-#                                  # and finite, AND that lifecycle events
+#                                  # and finite, that lifecycle events
 #                                  # were recorded and convert to valid
 #                                  # Chrome-trace JSON with quorum/step_commit
-#                                  # present (metric/event regressions fail
-#                                  # loudly instead of vanishing)
+#                                  # present, AND that the redist gauges are
+#                                  # finite with moved == lower-bound bytes
+#                                  # and a plan-cache hit on the second
+#                                  # identical transition (metric/event
+#                                  # regressions fail loudly instead of
+#                                  # vanishing)
 
 set -u
 cd "$(dirname "$0")/.."

@@ -1,0 +1,711 @@
+"""Redistribution engine (ISSUE 14): spec algebra, provably-minimal
+transfer plans, the spec-pair plan cache under world-size oscillation,
+multi-holder striping, dead-donor failover (whole-or-raise, never
+partial-adopt), the cohort exchange over a real loopback wire with
+``redist_moved_bytes == redist_lower_bound_bytes`` counter-pinned, the
+legacy-allgather A/B arm exceeding the bound, ``fetch_opt_shard`` on
+the planner, and DiLoCo's ``sharded_outer`` exchange-on-heal (outer
+momentum moves bitwise; reinit 0 when a covering donor survives).
+"""
+
+import copy
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.comm import StoreServer, TcpCommContext
+from torchft_tpu.comm.redistribute import (
+    RedistPlanner,
+    RedistTransferError,
+    ShardSpec,
+    TransferPlan,
+    execute_fetches,
+)
+from torchft_tpu.comm.wire_stub import WireStubManager, run_stub_ranks
+from torchft_tpu.ddp import shard_ranges
+
+TIMEOUT = 30.0
+
+
+@pytest.fixture()
+def store():
+    server = StoreServer()
+    yield server
+    server.shutdown()
+
+
+# ------------------------------------------------------------ spec algebra
+
+
+def test_spec_constructors_agree() -> None:
+    by_ranges = ShardSpec.from_ranges([(0, 2), (2, 5)], 5)
+    by_dict = ShardSpec(5, {0: [0, 1], 1: [2, 3, 4]})
+    assert by_ranges == by_dict
+    assert by_ranges.key() == by_dict.key()
+    assert hash(by_ranges) == hash(by_dict)
+    owner = ShardSpec.from_owner_map(6, 3, lambda u: u % 3)
+    assert owner.units_of(1) == (1, 4)
+    assert owner.holders_of(5) == (2,)
+    # empty holders are dropped; holders may overlap (post-heal dupes)
+    dup = ShardSpec(3, {0: [1], 1: [1], 2: []})
+    assert dup.holders() == (0, 1)
+    assert dup.holders_of(1) == (0, 1)
+    with pytest.raises(ValueError, match="outside the grid"):
+        ShardSpec(2, {0: [2]})
+
+
+def test_plan_minimal_no_overship_no_fanout() -> None:
+    """Each (receiver, needed unit) pair costs exactly one copy; held
+    units are never refetched; non-owners receive nothing; unsourced
+    units are reported, not silently dropped — and moved == the
+    set-theoretic lower bound by construction."""
+    src = ShardSpec(6, {0: [0, 1, 2], 1: [3, 4]})  # unit 5: dead owner
+    dst = ShardSpec.from_ranges([(0, 2), (2, 4), (4, 6)], 6)
+    unit_bytes = [10, 20, 30, 40, 50, 60]
+    plan = TransferPlan(src, dst, unit_bytes)
+    # receiver 0 holds 0,1 under src: fetches nothing
+    assert plan.receiver_fetches(0) == ()
+    # receiver 1 already holds 3 (it is src holder 1): needs ONLY 2 —
+    # nothing shipped that the receiver already holds
+    assert {u for u, _ in plan.receiver_fetches(1)} == {2}
+    # receiver 2 needs 4 (from 1); 5 is unsourced (dead owner)
+    assert {u for u, _ in plan.receiver_fetches(2)} == {4}
+    assert plan.receiver_unsourced(2) == (5,)
+    assert plan.moved_bytes == {1: 30, 2: 50}
+    assert plan.lower_bound_bytes == plan.moved_bytes
+    assert plan.total_moved_bytes() == 80
+    # senders = only holders actually named by some fetch
+    assert plan.senders == (0, 1)
+    assert plan.serve_units(0) == (2,)
+    assert plan.serve_units(1) == (4,)
+
+
+def test_plan_cache_oscillation_exactly_two_builds() -> None:
+    """w2→w3→w2→w3 over real shard grids: exactly 2 plan builds (one
+    per direction), the rest cache hits — the spec-pair cache
+    discipline (ISSUE 14 satellite)."""
+    sizes = [64, 33, 47, 12, 90]
+    dtypes = [np.dtype(np.float32)] * 5
+    w2 = ShardSpec.from_ranges(shard_ranges(sizes, dtypes, 2), 5)
+    w3 = ShardSpec.from_ranges(shard_ranges(sizes, dtypes, 3), 5)
+    unit_bytes = [s * 4 for s in sizes]
+    p = RedistPlanner()
+    plans = []
+    for src, dst in [(w2, w3), (w3, w2), (w2, w3), (w3, w2)]:
+        plans.append(p.plan(src, dst, unit_bytes))
+    assert p.builds == 2
+    assert p.hits == 2
+    assert plans[2] is plans[0] and plans[3] is plans[1]
+
+
+def test_multi_holder_striping_round_robin() -> None:
+    """A needed range with several covering holders stripes its pulls
+    across them instead of convoying on one donor; every non-primary
+    coverer stays listed as the failover order."""
+    src = ShardSpec(4, {0: [0, 1, 2, 3], 1: [0, 1, 2, 3]})
+    dst = ShardSpec(4, {2: [0, 1, 2, 3]})
+    plan = TransferPlan(src, dst, [8, 8, 8, 8])
+    primaries = [holders[0] for _, holders in plan.receiver_fetches(2)]
+    assert sorted(set(primaries)) == [0, 1]  # striped, not convoyed
+    assert primaries.count(0) == primaries.count(1) == 2
+    for _, holders in plan.receiver_fetches(2):
+        assert sorted(holders) == [0, 1]  # full failover order kept
+
+
+def test_execute_fetches_failover_whole_or_raises() -> None:
+    """A holder that dies mid-plan is excluded and its units refetched
+    from surviving coverers; a unit that exhausts its holders (unit 2's
+    ONLY holder is the dead one) fails the WHOLE call — no partial dict
+    ever escapes."""
+    src = ShardSpec(3, {0: [0, 1, 2], 1: [0, 1]})
+    dst = ShardSpec(3, {2: [0, 1, 2]})
+    plan = TransferPlan(src, dst, [4, 4, 4])
+    calls = []
+
+    def _fetch(holder, unit):
+        calls.append((holder, unit))
+        if holder == 0:
+            raise ConnectionError("holder 0 died")
+        return [np.full(1, unit, np.float32)]
+
+    with pytest.raises(RedistTransferError, match="unit 2"):
+        execute_fetches(plan, 2, _fetch, parallel=1)
+    # units 0/1 DID fail over to holder 1 before the raise
+    assert (1, 0) in calls and (1, 1) in calls
+
+
+def test_execute_fetches_failover_succeeds_when_covered() -> None:
+    src = ShardSpec(2, {0: [0, 1], 1: [0, 1]})
+    dst = ShardSpec(2, {2: [0, 1]})
+    plan = TransferPlan(src, dst, [4, 4])
+    dead = {0}
+
+    def _fetch(holder, unit):
+        if holder in dead:
+            raise ConnectionError(f"holder {holder} died")
+        return [np.full(2, unit + 1, np.float32)]
+
+    got, nbytes = execute_fetches(plan, 2, _fetch, parallel=2)
+    assert sorted(got) == [0, 1]
+    assert nbytes == 16
+    for u in (0, 1):
+        assert got[u][0].tolist() == [u + 1.0, u + 1.0]
+
+
+def test_execute_fetches_all_holders_dead_raises() -> None:
+    src = ShardSpec(2, {0: [0, 1], 1: [0, 1]})
+    dst = ShardSpec(2, {2: [0, 1]})
+    plan = TransferPlan(src, dst, [4, 4])
+
+    def _fetch(holder, unit):
+        raise ConnectionError(f"holder {holder} died")
+
+    with pytest.raises(RedistTransferError, match="died mid-plan"):
+        execute_fetches(plan, 2, _fetch, parallel=2)
+
+
+# --------------------------------------------- cohort exchange (loopback)
+
+
+def _make_params(seed=7):
+    rng = np.random.default_rng(seed)
+    return {
+        "a": rng.standard_normal((13, 5)).astype(np.float32),
+        "b": rng.standard_normal(31).astype(np.float32),
+        "c": rng.standard_normal((3, 3)).astype(np.float32),
+    }
+
+
+def _grad_seq(params_np, world, steps, seed=13):
+    return [
+        [
+            {k: (v * (0.1 * (s + 1)) * (r + 1)).astype(np.float32)
+             for k, v in params_np.items()}
+            for r in range(world)
+        ]
+        for s in range(steps)
+    ]
+
+
+def _run_arm(store, world, prefix, tx_fn, sharded=True, steps=2,
+             redistribute="plan", planners=None, carried=None):
+    """One wrapper arm over a live loopback wire; optionally resumes
+    rank r from ``carried[r]`` (deep-copied — runs mutate states) with
+    a shared per-rank planner."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchft_tpu.optim import ShardedOptimizerWrapper
+
+    params0 = _make_params()
+    gseq = _grad_seq(params0, world, steps)
+
+    def _fn(mgr, rank):
+        opt = ShardedOptimizerWrapper(
+            mgr, tx_fn(), sharded=sharded, redistribute=redistribute,
+            planner=None if planners is None else planners[rank],
+        )
+        params = jax.tree_util.tree_map(jnp.asarray, params0)
+        if carried is not None and carried[rank] is not None:
+            state = copy.deepcopy(carried[rank])
+        else:
+            state = opt.init(params)
+        for s in range(steps):
+            mgr.start_quorum()
+            params, state, committed = opt.step(
+                params, state, gseq[s][rank]
+            )
+            assert committed
+        return ({k: np.asarray(v) for k, v in params.items()},
+                state, mgr, opt)
+
+    return run_stub_ranks(
+        store.addr, prefix, world, _fn,
+        lambda: TcpCommContext(timeout=15.0, algorithm="star",
+                               chunk_bytes=256),
+        timeout=120,
+    )
+
+
+def test_exchange_grow_counters_pin_moved_equals_lower(store) -> None:
+    """w2→w3 grow over the planned exchange: every rank's
+    redist_moved_bytes == redist_lower_bound_bytes, nonzero on ranks
+    whose shard actually moved, with a redist_plan event recorded —
+    and the result stays bitwise with the legacy allgather arm, whose
+    received bytes EXCEED the bound (the A/B the bench grades)."""
+    import optax
+
+    tx_fn = lambda: optax.adam(1e-2)  # noqa: E731
+    w2 = _run_arm(store, 2, "g_w2", tx_fn)
+    carried = [w2[0][1], w2[1][1], None]
+    planned = _run_arm(store, 3, "g_w3p", tx_fn, steps=1,
+                       carried=carried)
+    legacy = _run_arm(store, 3, "g_w3l", tx_fn, steps=1,
+                      carried=carried, redistribute="allgather")
+    total_moved = 0
+    for rank in range(3):
+        snap = planned[rank][2].metrics.snapshot()
+        moved = snap.get("redist_moved_bytes")
+        lower = snap.get("redist_lower_bound_bytes")
+        assert moved is not None and lower is not None
+        assert moved == lower, f"rank {rank}: planned arm over-shipped"
+        total_moved += moved
+        events, _, _ = planned[rank][2].events.since(0)
+        plans = [e for e in events if e["kind"] == "redist_plan"]
+        assert plans and plans[0]["moved_bytes"] == int(moved)
+        assert plans[0]["lower_bound_bytes"] == int(lower)
+        assert plans[0]["source"] == "reshard"
+    assert total_moved > 0  # the grow genuinely moved state
+    legacy_excess = False
+    for rank in range(3):
+        snap = legacy[rank][2].metrics.snapshot()
+        assert snap["redist_moved_bytes"] >= snap[
+            "redist_lower_bound_bytes"
+        ]
+        if snap["redist_moved_bytes"] > snap["redist_lower_bound_bytes"]:
+            legacy_excess = True
+    assert legacy_excess, (
+        "the legacy allgather arm received no avoidable bytes — the "
+        "A/B lever is not measuring anything"
+    )
+    # both arms end bitwise identical (same states moved, different wire)
+    for rank in range(3):
+        for k in ("a", "b", "c"):
+            assert planned[rank][0][k].tobytes() == \
+                legacy[rank][0][k].tobytes()
+
+
+@pytest.mark.parametrize("codec", ["none", "int8"])
+def test_exchange_grow_bitwise_across_codecs(store, codec) -> None:
+    """The exchange moves raw state bytes regardless of the gradient
+    wire codec: a w2→w3 grow under int8 (EF engaged) matches the
+    legacy-allgather arm bitwise exactly like codec none."""
+    import optax
+
+    tx_fn = lambda: optax.sgd(0.1, momentum=0.9)  # noqa: E731
+
+    def _arm(prefix, world, carried=None, redistribute="plan"):
+        import jax
+        import jax.numpy as jnp
+
+        from torchft_tpu.optim import ShardedOptimizerWrapper
+
+        params0 = _make_params()
+        gseq = _grad_seq(params0, world, 2)
+
+        def _fn(mgr, rank):
+            opt = ShardedOptimizerWrapper(
+                mgr, tx_fn(), sharded=True, redistribute=redistribute
+            )
+            params = jax.tree_util.tree_map(jnp.asarray, params0)
+            state = (copy.deepcopy(carried[rank])
+                     if carried is not None and carried[rank] is not None
+                     else opt.init(params))
+            steps = 1 if carried is not None else 2
+            for s in range(steps):
+                mgr.start_quorum()
+                params, state, committed = opt.step(
+                    params, state, gseq[s][rank]
+                )
+                assert committed
+            return ({k: np.asarray(v) for k, v in params.items()}, state)
+
+        return run_stub_ranks(
+            store.addr, prefix, world, _fn,
+            lambda: TcpCommContext(timeout=15.0, algorithm="star",
+                                   compression=codec, chunk_bytes=256,
+                                   channels=2),
+            timeout=120,
+        )
+
+    w2 = _arm(f"cx_{codec}_w2", 2)
+    carried = [w2[0][1], w2[1][1], None]
+    planned = _arm(f"cx_{codec}_w3p", 3, carried=carried)
+    legacy = _arm(f"cx_{codec}_w3l", 3, carried=carried,
+                  redistribute="allgather")
+    for rank in range(3):
+        for k in ("a", "b", "c"):
+            assert planned[rank][0][k].tobytes() == \
+                legacy[rank][0][k].tobytes(), (codec, rank, k)
+
+
+def test_exchange_grow_stateless_transform_no_livelock(store) -> None:
+    """A stateless optax transformation (plain sgd — per-leaf state
+    flattens to ZERO arrays) must not schedule unservable fetches: the
+    exchange resolves zero-array units locally (empty slot lists, zero
+    wire bytes), the grow commits, and nothing latches — the
+    review-found livelock regression, pinned."""
+    import optax
+
+    tx_fn = lambda: optax.sgd(0.1)  # noqa: E731 — NO momentum: EmptyState
+    w2 = _run_arm(store, 2, "sl_w2", tx_fn)
+    carried = [w2[0][1], w2[1][1], None]
+    grown = _run_arm(store, 3, "sl_w3", tx_fn, steps=1, carried=carried)
+    for rank in range(3):
+        params, state, mgr, opt = grown[rank]
+        assert mgr.errored() is None
+        # every owned leaf holds a (structural) state — adopted, not
+        # livelocked; zero bytes moved == the zero-byte lower bound
+        assert state.held()
+        snap = mgr.metrics.snapshot()
+        assert snap["redist_moved_bytes"] == \
+            snap["redist_lower_bound_bytes"] == 0.0
+    for rank in range(1, 3):
+        for k in ("a", "b", "c"):
+            assert grown[rank][0][k].tobytes() == \
+                grown[0][0][k].tobytes()
+
+
+def test_exchange_grow_over_xla_plane(store) -> None:
+    """The exchange's collectives ride whatever data plane the manager
+    was built with: a w2→w3 grow over XlaCommContext (metadata/address/
+    ack allgathers on the xla backend, payload over HTTP) lands states
+    bitwise identical to the host-plane grow."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from torchft_tpu.comm.xla_backend import MeshManager, XlaCommContext
+    from torchft_tpu.optim import ShardedOptimizerWrapper
+
+    mm = MeshManager()
+    tx_fn = lambda: optax.adam(1e-2)  # noqa: E731
+    params0 = _make_params()
+
+    def _arm(prefix, world, carried=None):
+        gseq = _grad_seq(params0, world, 2)
+        ctxs = [
+            XlaCommContext(timeout=30.0, algorithm="star",
+                           chunk_bytes=256, mesh_manager=mm)
+            for _ in range(world)
+        ]
+        results = [None] * world
+
+        def _worker(rank):
+            ctxs[rank].configure(prefix, rank, world)
+            mgr = WireStubManager(ctxs[rank], world)
+            opt = ShardedOptimizerWrapper(mgr, tx_fn(), sharded=True)
+            params = jax.tree_util.tree_map(jnp.asarray, params0)
+            state = (copy.deepcopy(carried[rank])
+                     if carried is not None and carried[rank] is not None
+                     else opt.init(params))
+            steps = 1 if carried is not None else 2
+            for s in range(steps):
+                mgr.start_quorum()
+                params, state, committed = opt.step(
+                    params, state, gseq[s][rank]
+                )
+                assert committed
+            results[rank] = (
+                {k: np.asarray(v) for k, v in params.items()},
+                state, mgr,
+            )
+
+        with ThreadPoolExecutor(max_workers=world) as pool:
+            for f in [pool.submit(_worker, r) for r in range(world)]:
+                f.result(timeout=180)
+        for ctx in ctxs:
+            ctx.shutdown()
+        return results
+
+    w2 = _arm("xg_w2", 2)
+    carried = [w2[0][1], w2[1][1], None]
+    grown = _arm("xg_w3", 3, carried=carried)
+    # host-plane reference with identical config/grads
+    h2 = _run_arm(store, 2, "xg_h2", tx_fn)
+    hg = _run_arm(store, 3, "xg_h3", tx_fn, steps=1,
+                  carried=[h2[0][1], h2[1][1], None])
+    total_moved = 0.0
+    for rank in range(3):
+        for k in ("a", "b", "c"):
+            assert grown[rank][0][k].tobytes() == \
+                hg[rank][0][k].tobytes(), (rank, k)
+        snap = grown[rank][2].metrics.snapshot()
+        assert snap["redist_moved_bytes"] == \
+            snap["redist_lower_bound_bytes"]
+        total_moved += snap["redist_moved_bytes"]
+    assert total_moved > 0
+
+
+def test_exchange_second_identical_transition_is_cache_hit(store) -> None:
+    """The SAME w2→w3 transition twice through shared planners: the
+    second exchange compiles zero new plans (spec-pair cache)."""
+    import optax
+
+    tx_fn = lambda: optax.adam(1e-2)  # noqa: E731
+    w2 = _run_arm(store, 2, "c_w2", tx_fn)
+    carried = [w2[0][1], w2[1][1], None]
+    planners = [RedistPlanner() for _ in range(3)]
+    _run_arm(store, 3, "c_w3a", tx_fn, steps=1, carried=carried,
+             planners=planners)
+    builds_after_first = [p.builds for p in planners]
+    assert all(b == 1 for b in builds_after_first)
+    _run_arm(store, 3, "c_w3b", tx_fn, steps=1, carried=carried,
+             planners=planners)
+    for rank, p in enumerate(planners):
+        assert p.builds == 1, (
+            f"rank {rank} recompiled a seen spec pair (builds={p.builds})"
+        )
+        assert p.hits >= 1
+
+
+def test_exchange_dead_donor_mid_plan_never_partial_adopts(store) -> None:
+    """A donor that vanishes between publishing its address and serving
+    fails the receiver's plan WHOLE: the exchange returns ``None`` and
+    latches — never a partial fetched dict — while the cohort's
+    embedded collectives stay matched (ranks with no failed fetch
+    complete the same exchange normally)."""
+    from torchft_tpu import checkpointing as ckpt
+
+    real_serve = ckpt.serve_redist_payload
+
+    def _dying_serve(units, timeout=60.0):
+        addr, close = real_serve(units, timeout)
+        close()  # the donor dies right after advertising its address
+        return addr, (lambda: None)
+
+    dst = ShardSpec(6, {0: [0, 1], 1: [2, 3], 2: [4, 5]})
+    holdings_by_rank = {
+        0: {u: [np.full(3, 10 + u, np.float32)] for u in (0, 1, 2)},
+        1: {u: [np.full(3, 10 + u, np.float32)] for u in (3, 4, 5)},
+        2: {},
+    }
+
+    def _fn(mgr, rank):
+        planner = RedistPlanner()
+        result = ckpt.redistribute_exchange(
+            mgr, rank, 3, dst, holdings_by_rank[rank], planner,
+            timeout=5.0,
+        )
+        return result, mgr
+
+    try:
+        ckpt.serve_redist_payload = _dying_serve
+        res = run_stub_ranks(
+            store.addr, "dd_x", 3, _fn,
+            lambda: TcpCommContext(timeout=15.0, algorithm="star",
+                                   chunk_bytes=256),
+            timeout=120,
+        )
+    finally:
+        ckpt.serve_redist_payload = real_serve
+    # rank 0 fetches nothing (holds its dst shard): clean result
+    r0, mgr0 = res[0]
+    assert r0 is not None and r0.fetched == {} and r0.moved_bytes == 0
+    assert mgr0.errored() is None
+    # ranks 1 and 2 needed bytes from dead donors: WHOLE failure —
+    # None (no partial fetched dict ever escapes) + latched error
+    for rank in (1, 2):
+        result, mgr = res[rank]
+        assert result is None, f"rank {rank} partial-adopted"
+        assert mgr.errored() is not None
+
+
+def test_exchange_protocol_error_escalates_after_ack(store) -> None:
+    """An HTTP protocol error (the holder ANSWERED wrongly — version
+    skew, not a death) must RAISE out of the exchange after the ack
+    barrier instead of being swallowed into the silent latch-and-retry
+    path (HTTPError ⊂ OSError — the review-found shadowing, pinned)."""
+    import io
+    import urllib.error
+
+    from torchft_tpu.comm.redistribute import exchange
+
+    dst = ShardSpec(2, {0: [0], 1: [1]})
+    holdings_by_rank = {
+        0: {0: [np.ones(3, np.float32)], 1: [np.ones(3, np.float32)]},
+        1: {},
+    }
+
+    class _SkewFetcher:
+        def fetch(self, addr, unit):
+            raise urllib.error.HTTPError(
+                addr, 404, "not found", {}, io.BytesIO(b"")
+            )
+
+        def close(self):
+            pass
+
+    def _fn(mgr, rank):
+        planner = RedistPlanner()
+        try:
+            exchange(
+                mgr, rank, 2, dst, holdings_by_rank[rank], planner,
+                serve_fn=lambda units: ("http://127.0.0.1:9", lambda: None),
+                fetch_factory=_SkewFetcher,
+            )
+            return "ok"
+        except urllib.error.HTTPError:
+            return "raised"
+
+    res = run_stub_ranks(
+        store.addr, "pe_x", 2, _fn,
+        lambda: TcpCommContext(timeout=15.0, algorithm="star",
+                               chunk_bytes=256),
+        timeout=60,
+    )
+    # rank 1 fetched and must surface the protocol error loudly; rank 0
+    # (no fetches) completes — and neither hangs: the ack barrier ran
+    # on both before the raise
+    assert res[1] == "raised"
+    assert res[0] == "ok"
+
+
+# ------------------------------------------------ fetch_opt_shard on plan
+
+
+def test_fetch_opt_shard_stripes_and_counters(store) -> None:
+    """Duplicate donor coverage stripes leaf fetches across donors;
+    redist counters land moved == lower bound; the plan cache hits on
+    the second identical heal."""
+    import jax
+    import optax
+
+    from torchft_tpu.checkpointing import CheckpointServer, fetch_opt_shard
+    from torchft_tpu.comm.context import DummyCommContext
+    from torchft_tpu.optim import ShardedOptimizerWrapper
+    from torchft_tpu.utils.metrics import Metrics
+
+    tx_fn = lambda: optax.adam(1e-2)  # noqa: E731
+    full = _run_arm(store, 2, "fo_w2", tx_fn, sharded=False, steps=2)
+    helper = ShardedOptimizerWrapper(
+        WireStubManager(DummyCommContext(), 1), tx_fn(), sharded=True
+    )
+    helper._ensure_state_def()
+    k = helper._state_slots
+    state = full[0][1]
+    n_leaves = len(state.leaf_states)
+    # two donors with IDENTICAL full coverage — the striping case
+    servers = []
+    for _ in range(2):
+        srv = CheckpointServer(timeout=10.0)
+        srv.allow_checkpoint(3, {
+            "user": {"opt": helper.opt_state_dict(state)},
+            "torchft": {"step": 3},
+        })
+        servers.append(srv)
+    donors = [s.metadata() for s in servers]
+    try:
+        needed = list(range(n_leaves))
+        metrics = Metrics()
+        planner = RedistPlanner()
+        got = fetch_opt_shard(donors, 3, needed, state_slots=k,
+                              timeout=10.0, metrics=metrics,
+                              planner=planner)
+        assert sorted(got) == needed
+        for i in needed:
+            ref = jax.tree_util.tree_leaves(state.leaf_states[i])
+            for a, b in zip(got[i], ref):
+                assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        snap = metrics.snapshot()
+        assert snap["redist_moved_bytes"] == \
+            snap["redist_lower_bound_bytes"] > 0
+        assert planner.builds == 1
+        got2 = fetch_opt_shard(donors, 3, needed, state_slots=k,
+                               timeout=10.0, metrics=metrics,
+                               planner=planner)
+        assert planner.builds == 1 and planner.hits == 1
+        assert sorted(got2) == needed
+    finally:
+        for s in servers:
+            s.shutdown(wait=False)
+
+
+# ------------------------------------------- DiLoCo exchange-on-heal
+
+
+def _run_diloco(store, prefix, world, carried=None, rounds=1,
+                sync_every=4, fragments=3):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from torchft_tpu.local_sgd import DiLoCo
+
+    params0 = _make_params(seed=9)
+
+    def _fn(mgr, rank):
+        dl = DiLoCo(
+            mgr, optax.sgd(0.5, momentum=0.9), sync_every=sync_every,
+            num_fragments=fragments, streaming=True, sharded_outer=True,
+        )
+        params = dl.register(jax.tree_util.tree_map(jnp.asarray, params0))
+        if carried is not None and carried[rank] is not None:
+            dl.load_outer_state(copy.deepcopy(carried[rank]))
+        step = 0
+        for _ in range(rounds * sync_every):
+            step += 1
+            params = jax.tree_util.tree_map(
+                lambda x: x - 0.01 * (rank + 1) * step * jnp.ones_like(x),
+                params,
+            )
+            params = dl.step(params)
+        return ({k: np.asarray(v) for k, v in params.items()},
+                dl.outer_state, mgr)
+
+    return run_stub_ranks(
+        store.addr, prefix, world, _fn,
+        lambda: TcpCommContext(timeout=15.0, algorithm="star",
+                               chunk_bytes=256),
+        timeout=120,
+    )
+
+
+def test_diloco_sharded_outer_heal_exchanges_not_reinits(store) -> None:
+    """The ISSUE 14 gap-closer: a healer whose donor does NOT cover its
+    new fragments FETCHES the arriving outer states from the surviving
+    holder (reinit 0, moved == lower bound > 0), and the adopted
+    momentum is bitwise identical to a run where the healer carried
+    that holder's states directly."""
+    import jax
+
+    w2 = _run_diloco(store, "dh_w2", 2)
+    # w2 owner map f%2: rank 0 holds {f0, f2}, rank 1 holds {f1}.
+    # Grow to w3; the joiner (rank 2) healed from DONOR RANK 1, so it
+    # carries {f1} but owns f2 — held only by rank 0: a real fetch.
+    fetched_arm = _run_diloco(
+        store, "dh_w3f", 3, carried=[w2[0][1], w2[1][1], w2[1][1]],
+    )
+    events, _, _ = fetched_arm[2][2].events.since(0)
+    resh = [e for e in events if e["kind"] == "reshard"]
+    assert resh and resh[0]["source"] == "outer_sync"
+    assert resh[0]["adopted_fragments"] == 1
+    assert resh[0]["reinit_fragments"] == 0  # covering donor survived
+    assert resh[0]["wire_bytes"] == resh[0]["lower_bound_bytes"] > 0
+    plans = [e for e in events if e["kind"] == "redist_plan"]
+    assert plans and plans[0]["source"] == "outer_sync"
+    snap = fetched_arm[2][2].metrics.snapshot()
+    assert snap["redist_moved_bytes"] == \
+        snap["redist_lower_bound_bytes"] > 0
+    # oracle: identical trajectory to a healer that carried the
+    # holder's states locally (no fetch needed there)
+    carried_arm = _run_diloco(
+        store, "dh_w3c", 3, carried=[w2[0][1], w2[1][1], w2[0][1]],
+    )
+    for k in ("a", "b", "c"):
+        assert fetched_arm[2][0][k].tobytes() == \
+            carried_arm[2][0][k].tobytes()
+    for a, b in zip(
+        jax.tree_util.tree_leaves(fetched_arm[2][1][2]),
+        jax.tree_util.tree_leaves(carried_arm[2][1][2]),
+    ):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_diloco_shrink_reinit_only_when_uncovered(store) -> None:
+    """w3→w2 where the departed rank's fragment states died with it:
+    the arriving fragment reinitializes (counted, never silent) — the
+    honest unavoidable case — while covered fragments keep state."""
+    w3 = _run_diloco(store, "ds_w3", 3)
+    # w3 owner map: rank0 {f0}, rank1 {f1}, rank2 {f2}; rank 2 dies.
+    res = _run_diloco(store, "ds_w2", 2,
+                      carried=[w3[0][1], w3[1][1]])
+    # w2 owner map: rank0 {f0, f2}, rank1 {f1}; f2's holder is gone
+    events, _, _ = res[0][2].events.since(0)
+    resh = [e for e in events if e["kind"] == "reshard"]
+    assert resh and resh[0]["reinit_fragments"] == 1
+    assert resh[0]["adopted_fragments"] == 0
+    ev1, _, _ = res[1][2].events.since(0)
+    resh1 = [e for e in ev1 if e["kind"] == "reshard"]
+    assert resh1 and resh1[0]["reinit_fragments"] == 0

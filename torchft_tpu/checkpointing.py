@@ -72,6 +72,11 @@ from typing import Any, Dict, Generic, List, Optional, Sequence, TypeVar
 
 import numpy as np
 
+from torchft_tpu.comm.redistribute import (
+    RedistPlanner,
+    ShardSpec,
+    execute_fetches,
+)
 from torchft_tpu.comm.wire import (
     as_bytes_view,
     bf16_wire_dtype,
@@ -90,12 +95,15 @@ T = TypeVar("T")
 __all__ = [
     "CheckpointTransport",
     "CheckpointServer",
+    "RedistFetcher",
     "fetch_manifest",
     "fetch_leaf",
     "fetch_opt_shard",
     "format_slice_spec",
     "recv_checkpoint_sharded",
+    "redistribute_exchange",
     "serve_copy_stats",
+    "serve_redist_payload",
 ]
 
 # Chunk size for streaming a staged leaf's byte view into the socket:
@@ -1782,6 +1790,12 @@ def recv_checkpoint_sharded(
     return jax.tree_util.tree_unflatten(t_def, leaves)
 
 
+# The heal path's shared plan cache: donor spec pairs repeat across
+# heals of a stable fleet layout (the same "seen spec pair costs zero
+# builds" discipline the wrapper-owned planners get).
+_OPT_SHARD_PLANNER = RedistPlanner()
+
+
 def fetch_opt_shard(
     donors: "Sequence[str]",
     step: int,
@@ -1791,10 +1805,15 @@ def fetch_opt_shard(
     timeout: float = 60.0,
     parallel: int = 4,
     metrics: "Optional[Any]" = None,
+    planner: "Optional[RedistPlanner]" = None,
+    events: "Optional[Any]" = None,
 ) -> "Dict[int, List[np.ndarray]]":
     """Shard-spec-aware optimizer-state fetch for a healer joining at a
-    *different* world size (the "Memory-efficient array redistribution"
-    recipe specialized to leaf-granular shards).
+    *different* world size — a client of the redistribution engine
+    (comm/redistribute.py): the donor manifests ARE the source shard
+    spec, ``needed`` is the destination, and the compiled plan is
+    provably minimal (each missing leaf fetched exactly once, striped
+    across its covering donors).
 
     Each donor's checkpoint carries only ITS 1/N shard of the per-leaf
     optimizer states, in a FIXED tree structure where non-held leaves
@@ -1803,17 +1822,17 @@ def fetch_opt_shard(
     therefore its shard spec: leaf ``i`` is held exactly when every one
     of its ``state_slots`` slot entries (manifest paths matching
     ``slots_path_re`` with groups ``(leaf, slot)``) advertises
-    ``nbytes > 0``. This function computes the intersection of
-    ``needed`` against every donor's spec and fetches exactly the
-    missing pieces — each leaf's slot arrays from ONE donor that holds
-    it (lowest in ``donors`` order) over keep-alive connections,
-    generalizing PR 4's dim-0 stripes to shard-spec-to-shard-spec
-    transfer on the same ``/checkpoint/{step}/leaf/{i}`` raw plane.
+    ``nbytes > 0``. The (src spec → needed) plan is cached per spec
+    pair (module-shared planner unless ``planner`` is supplied) with
+    ``redist_plan_builds``/``redist_plan_cache_hits`` counters, and the
+    fetched bytes are pinned against the plan's lower bound
+    (``redist_moved_bytes``/``redist_lower_bound_bytes``).
 
-    Donor-death failover: a donor that dies mid-fetch (network error,
-    not an HTTP protocol error) is marked dead and each of its assigned
-    leaves is refetched from the surviving donors that cover it; the
-    fetch completes whole or raises — no partial shard is returned.
+    Donor-death failover rides the engine: a donor that dies mid-fetch
+    (network error, not an HTTP protocol error) is excluded and each of
+    its assigned leaves refetched from the surviving donors that cover
+    it; the fetch completes whole or raises — no partial shard is ever
+    returned.
 
     Returns ``{leaf_index: [slot arrays...]}`` for every index in
     ``needed`` (feed ``ShardedOptimizerWrapper._unflatten_state`` /
@@ -1825,18 +1844,20 @@ def fetch_opt_shard(
         return {}
     pat = _re.compile(slots_path_re)
 
-    # donor -> {leaf: {slot: manifest_index}}, only for fully-held leaves
+    # donor -> {leaf: {slot: manifest_index}}, only for fully-held
+    # leaves; per-leaf byte sizes ride along for the plan's accounting.
     coverage: "Dict[str, Dict[int, Dict[int, int]]]" = {}
+    leaf_bytes: "Dict[int, int]" = {}
     for donor in donors:
         try:
             manifest = fetch_manifest(donor, step, timeout=timeout)
         except Exception as e:  # noqa: BLE001 — a dead donor only
-            # narrows coverage; the assignment below raises if it stays
-            # short
+            # narrows coverage; the plan below raises if it stays short
             logger.warning("opt-shard manifest fetch failed %s: %s",
                            donor, e)
             continue
         slots: "Dict[int, Dict[int, int]]" = {}
+        sizes: "Dict[int, int]" = {}
         for mi, entry in enumerate(manifest["leaves"]):
             m = pat.match(entry.get("path", ""))
             if m is None or entry.get("kind") != "ndarray":
@@ -1845,19 +1866,31 @@ def fetch_opt_shard(
                 continue
             leaf, slot = int(m.group(1)), int(m.group(2))
             slots.setdefault(leaf, {})[slot] = mi
+            sizes[leaf] = sizes.get(leaf, 0) + int(entry["nbytes"])
         coverage[donor] = {
             leaf: by_slot for leaf, by_slot in slots.items()
             if len(by_slot) == state_slots
         }
+        for leaf in coverage[donor]:
+            leaf_bytes[leaf] = max(leaf_bytes.get(leaf, 0), sizes[leaf])
 
-    def _holders(leaf: int, dead: "set") -> "List[str]":
-        return [
-            d for d in donors
-            if d not in dead and leaf in coverage.get(d, {})
-        ]
-
-    dead: "set" = set()
-    missing = [i for i in needed if not _holders(i, dead)]
+    # Specs over the leaf grid: holders are donor POSITIONS (stable
+    # within a call and across calls with the same donor list — the
+    # cache key), the healer is one receiver past them.
+    n_units = max(
+        [*needed, *(l for c in coverage.values() for l in c)]
+    ) + 1
+    src = ShardSpec(n_units, {
+        di: list(coverage[d])
+        for di, d in enumerate(donors) if coverage.get(d)
+    })
+    receiver = len(donors)
+    dst = ShardSpec(n_units, {receiver: needed})
+    unit_bytes = [leaf_bytes.get(u, 0) for u in range(n_units)]
+    planner = planner if planner is not None else _OPT_SHARD_PLANNER
+    hits0 = planner.hits
+    plan = planner.plan(src, dst, unit_bytes, metrics=metrics)
+    missing = list(plan.receiver_unsourced(receiver))
     if missing:
         raise ConnectionError(
             f"no donor covers optimizer-state leaves {missing[:8]}"
@@ -1867,65 +1900,213 @@ def fetch_opt_shard(
         )
 
     conn_pool = _ConnPool(timeout)
-    out: "Dict[int, List[np.ndarray]]" = {}
-    out_lock = threading.Lock()
-    total_bytes = [0]
 
-    def _fetch_leaf_states(leaf: int) -> None:
-        last_exc: "Optional[Exception]" = None
-        for donor in _holders(leaf, dead):
-            by_slot = coverage[donor][leaf]
-            nb = [0]
-            try:
-                with throughput_span(metrics, "heal_wire", nb):
-                    conn = conn_pool.acquire(donor)
-                    try:
-                        arrays = []
-                        for slot in range(state_slots):
-                            arr = fetch_leaf(
-                                donor, step, by_slot[slot],
-                                timeout=timeout, conn=conn,
-                            )
-                            arrays.append(np.asarray(arr))
-                    except BaseException:
-                        conn.close()  # possibly mid-body: not reusable
-                        raise
-                    conn_pool.release(donor, conn)
-                    nb[0] = sum(int(a.nbytes) for a in arrays)
-                with out_lock:
-                    out[leaf] = arrays
-                    total_bytes[0] += nb[0]
-                return
-            except urllib.error.HTTPError:
-                raise  # donor answered: protocol error, not a death
-            except (urllib.error.URLError, http.client.HTTPException,
-                    ConnectionError, socket.timeout, TimeoutError,
-                    OSError) as e:
-                logger.warning(
-                    "opt-shard donor %s died fetching leaf %d: %s",
-                    donor, leaf, e,
-                )
-                dead.add(donor)
-                last_exc = e
-        raise ConnectionError(
-            f"optimizer-state leaf {leaf}: every covering donor died "
-            "mid-fetch"
-        ) from last_exc
+    def _fetch_unit(holder: int, leaf: int) -> "List[np.ndarray]":
+        donor = donors[holder]
+        by_slot = coverage[donor][leaf]
+        nb = [0]
+        with throughput_span(metrics, "heal_wire", nb):
+            arrays = _pool_fetch_leaves(
+                conn_pool, donor, step,
+                [by_slot[slot] for slot in range(state_slots)],
+                timeout, what=f"opt-shard leaf {leaf}",
+            )
+            nb[0] = sum(int(a.nbytes) for a in arrays)
+        return arrays
 
     try:
-        with ThreadPoolExecutor(
-            max_workers=max(1, min(parallel, len(needed))),
-            thread_name_prefix="torchft_tpu_opt_shard",
-        ) as pool:
-            futures = [pool.submit(_fetch_leaf_states, i) for i in needed]
-            for f in futures:
-                f.result()
+        out, total_bytes = execute_fetches(
+            plan, receiver, _fetch_unit, parallel=parallel
+        )
     finally:
         conn_pool.close_all()
+    lower = plan.lower_bound_bytes.get(receiver, 0)
     if metrics is not None:
-        metrics.gauge("heal_opt_bytes", float(total_bytes[0]))
-        metrics.incr("heal_opt_bytes_total", float(total_bytes[0]))
+        metrics.gauge("heal_opt_bytes", float(total_bytes))
+        metrics.incr("heal_opt_bytes_total", float(total_bytes))
+        metrics.incr("redist_moved_bytes", float(total_bytes))
+        metrics.incr("redist_lower_bound_bytes", float(lower))
+    if events:
+        events.emit(
+            "redist_plan", source="opt_shard_heal",
+            src_spec=src.fingerprint(), dst_spec=dst.fingerprint(),
+            n_units=n_units, cache_hit=planner.hits > hits0,
+            fetches=len(plan.receiver_fetches(receiver)),
+            unsourced=0,
+            moved_bytes=int(total_bytes), lower_bound_bytes=int(lower),
+        )
     return out
+
+
+# ------------------------------------------------- redistribution transport
+# The byte-movement hooks comm/redistribute.py injects (layering: comm/
+# may not import this module): publishing rides an EPHEMERAL
+# CheckpointServer — lazy per-leaf staging means over-publication costs
+# metadata only — and fetching rides the same keep-alive _DonorConn /
+# fetch_leaf raw plane every heal uses. Exchanges happen at membership
+# changes (rare), so a fresh server per exchange beats a persistent one
+# fighting the Manager's heal-serving gate for the staging slot.
+
+_REDIST_STEP = 0
+_REDIST_PATH_RE = r".*\['units'\]\['(\d+)'\]\[(\d+)\]$"
+
+
+def _pool_fetch_leaves(
+    pool: _ConnPool, host: str, step: int, indices: "Sequence[int]",
+    timeout: float, what: str = "unit",
+) -> "List[np.ndarray]":
+    """THE keep-alive manifest-indexed fetch: acquire a pooled donor
+    connection, fetch each leaf index in order, release only after the
+    bodies were consumed exactly (close — never release — on error: a
+    conn with stale bytes would parse tensor bytes as a status line),
+    with the death vocabulary the redistribution engine's failover
+    keys on — ``urllib.error.HTTPError`` passes through (the holder
+    ANSWERED: protocol error / version skew, escalate), everything
+    transport-shaped normalizes to ``ConnectionError``/``OSError``
+    family. Shared by ``fetch_opt_shard`` and :class:`RedistFetcher`
+    so the two redistribution clients cannot drift in failover
+    behavior."""
+    try:
+        conn = pool.acquire(host)
+        try:
+            arrays = [
+                np.asarray(fetch_leaf(
+                    host, step, int(mi), timeout=timeout, conn=conn,
+                ))
+                for mi in indices
+            ]
+        except BaseException:
+            conn.close()  # possibly mid-body: not reusable
+            raise
+        pool.release(host, conn)
+        return arrays
+    except urllib.error.HTTPError:
+        raise  # the holder answered: protocol error, not a death
+    except (http.client.HTTPException, socket.timeout) as e:
+        # normalize to the engine's death vocabulary (URLError and
+        # ConnectionError are already OSError family)
+        raise ConnectionError(
+            f"holder {host} died fetching {what}: {e}"
+        ) from e
+
+
+def serve_redist_payload(
+    units: "Dict[int, Sequence[Any]]", timeout: float = 60.0,
+) -> "tuple[str, Any]":
+    """Publish a holder's redistribution payload: one ephemeral
+    checkpoint server staging ``{"units": {str(u): [arrays...]}}`` at
+    the fixed redist step. Arrays may be DEVICE arrays — the server's
+    lazy per-leaf staging defers any device-to-host copy until a
+    receiver actually fetches that unit (host ndarrays are snapshot
+    eagerly, which is what makes the close-side drain safe). Returns
+    ``(address, close)``; ``close()`` drains residual staging and
+    tears the server down. The ``serve_fn`` hook of
+    ``comm.redistribute.exchange``."""
+    srv = CheckpointServer(timeout=timeout)
+    tree = {
+        "units": {
+            str(int(u)): list(arrays)
+            for u, arrays in units.items()
+        }
+    }
+    srv.allow_checkpoint(_REDIST_STEP, tree)
+
+    def _close() -> None:
+        try:
+            srv.disallow_checkpoint()
+        finally:
+            srv.shutdown(wait=False)
+
+    return srv.metadata(), _close
+
+
+class RedistFetcher:
+    """Pull side of the redistribution plane: per-address manifest
+    cache + keep-alive connection pool over the ``fetch_leaf`` raw
+    plane. ``fetch(address, unit)`` returns the unit's arrays in slot
+    order; holder death surfaces as ``ConnectionError``/``OSError`` so
+    the engine's failover can reroute. The ``fetch_factory`` hook of
+    ``comm.redistribute.exchange``."""
+
+    def __init__(self, timeout: float = 60.0) -> None:
+        import re as _re
+
+        self._timeout = float(timeout)
+        self._pool = _ConnPool(self._timeout)
+        self._pat = _re.compile(_REDIST_PATH_RE)
+        self._slots: "Dict[str, Dict[int, List[int]]]" = {}
+        self._lock = threading.Lock()
+
+    def _unit_slots(self, addr: str) -> "Dict[int, List[int]]":
+        with self._lock:
+            cached = self._slots.get(addr)
+        if cached is not None:
+            return cached
+        manifest = fetch_manifest(
+            addr, _REDIST_STEP, timeout=self._timeout
+        )
+        by_unit: "Dict[int, Dict[int, int]]" = {}
+        for mi, entry in enumerate(manifest["leaves"]):
+            m = self._pat.match(entry.get("path", ""))
+            if m is None or entry.get("kind") != "ndarray":
+                continue
+            by_unit.setdefault(int(m.group(1)), {})[int(m.group(2))] = mi
+        slots = {
+            u: [by_slot[s] for s in sorted(by_slot)]
+            for u, by_slot in by_unit.items()
+        }
+        with self._lock:
+            self._slots[addr] = slots
+        return slots
+
+    def fetch(self, addr: str, unit: int) -> "List[np.ndarray]":
+        try:
+            slots = self._unit_slots(addr)
+        except urllib.error.HTTPError:
+            raise  # protocol error, not a death
+        except (http.client.HTTPException, socket.timeout) as e:
+            raise ConnectionError(
+                f"redist holder {addr} died serving its manifest: {e}"
+            ) from e
+        if int(unit) not in slots:
+            raise ConnectionError(
+                f"holder {addr} advertises no unit {unit} — its "
+                "published spec and the plan diverged"
+            )
+        return _pool_fetch_leaves(
+            self._pool, addr, _REDIST_STEP, slots[int(unit)],
+            self._timeout, what=f"unit {unit}",
+        )
+
+    def close(self) -> None:
+        self._pool.close_all()
+
+
+def redistribute_exchange(
+    mgr: Any,
+    my_rank: int,
+    world: int,
+    dst_spec: ShardSpec,
+    holdings: "Dict[int, Sequence[Any]]",
+    planner: RedistPlanner,
+    timeout: float = 60.0,
+    parallel: int = 4,
+    source: str = "reshard",
+):
+    """``comm.redistribute.exchange`` bound to the raw-bytes heal plane
+    — THE cohort redistribution call the sharded optimizer wrapper and
+    DiLoCo's ``sharded_outer`` heal retarget onto. Returns the
+    engine's ``ExchangeResult`` or ``None`` (wire latched / transfer
+    failed whole — caller keeps its old grid and the next healthy
+    quorum retries)."""
+    from torchft_tpu.comm.redistribute import exchange
+
+    return exchange(
+        mgr, my_rank, world, dst_spec, holdings, planner,
+        serve_fn=lambda units: serve_redist_payload(units, timeout),
+        fetch_factory=lambda: RedistFetcher(timeout),
+        parallel=parallel, source=source,
+    )
 
 
 def _recv_chunked(

@@ -803,6 +803,14 @@ class _ShardPlan(_BucketPlan):
                 return shard
         raise IndexError(f"leaf {leaf} outside the shard grid")
 
+    def shard_spec(self):
+        """This grid as a redistribution destination spec — what the
+        reshard exchange compiles (src holdings → this) transfer plans
+        against (comm/redistribute.py)."""
+        from torchft_tpu.comm.redistribute import ShardSpec
+
+        return ShardSpec.from_ranges(self.ranges, len(self.sizes))
+
     def owned_leaves(self, rank: int) -> "List[int]":
         if rank >= len(self.ranges):
             return []

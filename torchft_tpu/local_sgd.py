@@ -206,9 +206,12 @@ class LocalSGD:
         stay bitwise identical to the replicated arm). Must match
         across replicas (it changes the collective sequence); an owner
         map changed by membership churn — heals included, since a
-        donor ships only its own fragments — reinitializes the moved
-        fragments' outer state at the next round fence, made visible by
-        a ``reshard`` event (see ``_on_owner_map``)."""
+        donor ships only its own fragments — EXCHANGES the moved
+        fragments' outer state at the next round fence through the
+        redistribution engine (fetched from a surviving holder over
+        the raw-bytes heal plane; reinitialized only when no holder
+        survives), made visible by a ``reshard`` event (see
+        ``_on_owner_map``)."""
         assert sync_every >= 1, "sync_every must be >= 1"
         if num_fragments < 1:
             raise ValueError("num_fragments must be >= 1")
@@ -242,6 +245,11 @@ class LocalSGD:
         self._error_feedback = error_feedback
         self._sharded_outer = bool(sharded_outer)
         self._outer_world: "Optional[Tuple[int, int]]" = None
+        # Transport incarnation of the last sharded-outer reshard — the
+        # cohort-synchronized trigger (every membership change bumps it
+        # on every wire member at the same quorum boundary, which is
+        # what keeps the exchange's collectives matched).
+        self._outer_gen: "Optional[int]" = None
         self._local_step = 0
         self._healed_backup = False
         # Frozen leaf layout (built at register / first step) — the
@@ -961,7 +969,12 @@ class DiLoCo(LocalSGD):
             error_feedback=error_feedback, sharded_outer=sharded_outer,
             topology=topology,
         )
+        from torchft_tpu.comm.redistribute import RedistPlanner
+
         self._outer = PartitionedOuterOptimizer(outer_tx)
+        # Sharded-outer reshard plans, cached per (holdings, owner-map)
+        # spec pair — kill→reform oscillation replans zero times.
+        self._redist_planner = RedistPlanner()
 
     def register(self, params: Any) -> Any:
         params = super().register(params)
@@ -1047,40 +1060,108 @@ class DiLoCo(LocalSGD):
                 f, grads, frag_params
             )
 
+    def _adopt_fragment_state(self, f: int, leaves: "List[Any]",
+                              arrays: "List[np.ndarray]") -> Any:
+        """A fetched fragment outer state, rebuilt from its flattened
+        wire arrays: the tree STRUCTURE comes from a fresh
+        ``init_fragment`` template over this rank's own leaves (optax
+        states are pure functions of the leaf list's shapes), the
+        VALUES are the donor's bytes verbatim — outer momentum survives
+        the move bitwise."""
+        import jax
+        import jax.numpy as jnp
+
+        start, stop = self._fragments[f]
+        template = self._outer.init_fragment(
+            [jnp.asarray(leaves[i]) for i in range(start, stop)]
+        )
+        t_leaves, treedef = jax.tree_util.tree_flatten(template)
+        if len(arrays) != len(t_leaves):
+            raise ValueError(
+                f"fragment {f}: donor shipped {len(arrays)} outer-state "
+                f"arrays, the transformation expects {len(t_leaves)} — "
+                "outer optimizer configs diverged across replicas"
+            )
+        return jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(a) for a in arrays]
+        )
+
     def _on_owner_map(self, rnd: _SyncRound, params: Any) -> None:
-        """Sharded outer reshard: fragments are the shard unit, owners
-        are ``f % wire_world``. On an owner-map change (membership
-        churn), drop the states of fragments that left this rank and
-        (re)initialize the ones that arrived — a momentum reset for the
-        moved fragments, surfaced by a ``reshard`` event. NOTE this
-        includes heals: a donor's checkpoint carries only the DONOR's
-        owned fragments, and a healer's wire rank differs from its
-        donor's, so a sharded_outer heal adopts what overlaps (usually
-        nothing) and reinitializes the rest — outer momentum restarts
-        for the healer's shard, visibly. Fragment-state exchange on
-        heal (the ShardedOptimizerWrapper treatment) is future work;
-        jobs that cannot tolerate outer-momentum resets on heal should
-        run the replicated outer plane. Runs once per round, at the
-        fence."""
+        """Sharded outer reshard — EXCHANGE-ON-HEAL (closing the PR 8
+        reinit gap): fragments are the shard unit, owners are
+        ``f % wire_world``. On an owner-map change (membership churn,
+        heals included — a donor's checkpoint carries only the DONOR's
+        owned fragments and a healer's wire rank differs), the cohort
+        runs one redistribution exchange (comm/redistribute.py over the
+        raw-bytes heal plane): holdings metadata allgathered, a cached
+        (held → owner-map) transfer plan compiled, and each ARRIVING
+        fragment's outer state fetched from a surviving holder — outer
+        momentum moves with the fragment instead of resetting. Only
+        fragments NO live rank holds reinitialize (``reinit_fragments``
+        in the ``reshard`` event — 0 whenever a covering donor
+        survives). Runs once per round, at the fence; the trigger
+        (generation bump / first sight) is cohort-synchronized so the
+        embedded collectives stay matched."""
         import jax
 
+        gen_fn = getattr(self._manager, "wire_generation", None)
+        gen = int(gen_fn()) if callable(gen_fn) else 0
         key = (rnd.world, rnd.rank)
         states = self._outer.states
-        if states is None or key == self._outer_world:
+        if states is None or (
+            key == self._outer_world and gen == self._outer_gen
+        ):
             self._outer_world = key
+            self._outer_gen = gen
             return
-        owned = [
-            f for f in range(len(self._fragments))
+        F = len(self._fragments)
+        owned = {
+            f for f in range(F)
             if self._frag_owner(rnd, f) == rnd.rank or rnd.world == 1
-        ]
+        }
         leaves = jax.tree_util.tree_flatten(params)[0]
         self._check_layout(leaves)
-        moved = dropped = 0
-        new_states: List[Any] = [None] * len(self._fragments)
-        for f in range(len(self._fragments)):
+        held = [f for f in range(F) if states[f] is not None]
+        fetched: "dict[int, List[np.ndarray]]" = {}
+        wire_bytes = lower_bound = 0
+        if rnd.world > 1:
+            from torchft_tpu.checkpointing import redistribute_exchange
+            from torchft_tpu.comm.redistribute import ShardSpec
+
+            # Device arrays stay device-side: the exchange reads nbytes
+            # metadata only, and served fragments stage lazily (D2H
+            # exactly when a receiver fetches).
+            holdings = {
+                f: list(jax.tree_util.tree_leaves(states[f]))
+                for f in held
+            }
+            dst = ShardSpec.from_owner_map(
+                F, rnd.world, lambda f: self._frag_owner(rnd, f)
+            )
+            result = redistribute_exchange(
+                self._manager, rnd.rank, rnd.world, dst, holdings,
+                self._redist_planner, source="outer_sync",
+            )
+            if result is None:
+                # Latched mid-exchange / transfer failed whole: keep
+                # the old states and do NOT advance the (gen, key)
+                # marker — this round aborts at its commit barrier and
+                # the next round's fence retries the exchange.
+                return
+            fetched = result.fetched
+            wire_bytes = result.moved_bytes
+            lower_bound = result.lower_bound_bytes
+        reinit = dropped = adopted = 0
+        new_states: List[Any] = [None] * F
+        for f in range(F):
             if f in owned:
                 if states[f] is not None:
                     new_states[f] = states[f]
+                elif f in fetched:
+                    new_states[f] = self._adopt_fragment_state(
+                        f, leaves, fetched[f]
+                    )
+                    adopted += 1
                 else:
                     start, stop = self._fragments[f]
                     import jax.numpy as jnp
@@ -1089,12 +1170,19 @@ class DiLoCo(LocalSGD):
                         [jnp.asarray(leaves[i])
                          for i in range(start, stop)]
                     )
-                    moved += 1
+                    reinit += 1
             elif states[f] is not None:
                 dropped += 1
+        if reinit:
+            logger.warning(
+                "sharded_outer reshard reinitialized %d fragment outer "
+                "states (no surviving holder): outer momentum restarts "
+                "for those fragments", reinit,
+            )
         self._outer.load_states(new_states)
         old = self._outer_world
         self._outer_world = key
+        self._outer_gen = gen
         ev = getattr(self._manager, "events", None)
         if ev:
             ev.emit(
@@ -1102,7 +1190,10 @@ class DiLoCo(LocalSGD):
                 old_world=None if old is None else old[0],
                 new_world=rnd.world, rank=rnd.rank,
                 owned_fragments=len(owned),
-                reinit_fragments=moved, dropped_fragments=dropped,
+                adopted_fragments=adopted,
+                wire_bytes=wire_bytes,
+                lower_bound_bytes=lower_bound,
+                reinit_fragments=reinit, dropped_fragments=dropped,
             )
 
     def _commit_round(self, rnd: _SyncRound) -> Any:

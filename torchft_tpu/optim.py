@@ -188,10 +188,23 @@ class ShardedOptimizerWrapper:
     wrapper owns the cross-replica reduction.
 
     Resharding: every transport incarnation change (quorum membership
-    change) triggers ONE reshard exchange — an allgather where each
-    rank contributes the leaf states leaving its shard — after which
-    each rank holds exactly its new shard. Leaf states whose old owner
-    died are REINITIALIZED (a momentum reset for that 1/N slice, made
+    change) triggers ONE reshard exchange, compiled by the
+    redistribution engine (comm/redistribute.py): the cohort allgathers
+    holdings METADATA only (tiny), every rank derives the same
+    (src spec → new grid) transfer plan — cached per spec pair, so
+    repeated world-size oscillation replans zero times — and exactly
+    the leaf states whose owner changed move point-to-point over the
+    raw-bytes heal plane to their ONE new owner
+    (``redist_moved_bytes == redist_lower_bound_bytes``, counter-pinned).
+    ``redistribute="allgather"`` keeps the legacy exchange — each rank
+    allgathers every departing leaf state to the WHOLE cohort — as the
+    live A/B arm whose wire bytes measurably exceed the bound
+    (``scripts/bench_reshard.py``). Like ``sharded``, ``redistribute``
+    MUST match across replicas: it changes the collective sequence at
+    every membership change (the planned arm runs address/ack
+    allgathers the legacy arm never posts — mixed arms wedge the wire
+    until the transport timeout latches). Leaf states no surviving rank holds
+    are REINITIALIZED (a momentum reset for that 1/N slice, made
     visible by the ``reshard`` event's ``reinit_leaves`` count; donors'
     checkpoints + ``checkpointing.fetch_opt_shard`` cover the heal path
     bitwise). A healer's fetched donor shard enters the same exchange,
@@ -206,16 +219,32 @@ class ShardedOptimizerWrapper:
     (the same window :meth:`OptimizerWrapper.fused_step` documents)."""
 
     def __init__(self, manager, tx, state_fn=None, sharded: bool = True,
-                 error_feedback: "bool | str" = "auto") -> None:
+                 error_feedback: "bool | str" = "auto",
+                 redistribute: str = "plan",
+                 planner=None) -> None:
         import jax
         import optax
 
+        from torchft_tpu.comm.redistribute import RedistPlanner
         from torchft_tpu.ddp import ShardedGradReducer
 
+        if redistribute not in ("plan", "allgather"):
+            raise ValueError(
+                f"redistribute must be 'plan' (minimal transfer plans "
+                f"over the heal plane) or 'allgather' (the legacy "
+                f"full-departing-leaf broadcast A/B arm), "
+                f"got {redistribute!r}; the choice must match across "
+                "replicas — it changes the reshard collective sequence"
+            )
         self.manager = manager
         self.tx = tx
         self._state_fn = state_fn
         self._sharded = bool(sharded)
+        self._redistribute = redistribute
+        # Plan cache (hit/miss-counted): per-wrapper unless a shared
+        # planner is injected (bench/smoke harnesses pin cache behavior
+        # across arms/transitions through one instance).
+        self._planner = planner if planner is not None else RedistPlanner()
         self._reducer = ShardedGradReducer(
             manager, error_feedback=error_feedback
         )
@@ -301,12 +330,17 @@ class ShardedOptimizerWrapper:
                        plan, my_rank: int) -> ShardedOptState:
         """Redistribute per-leaf states at the quorum boundary when the
         transport incarnation changed (membership change / heal /
-        first step). One allgather: each rank contributes the states
-        LEAVING its shard; every new owner picks what it needs (lowest
+        first step). Default path: the redistribution engine — one tiny
+        holdings-metadata allgather, a cached (src spec → new grid)
+        transfer plan, and point-to-point fetches of exactly the leaf
+        states whose owner changed (comm/redistribute.py; nothing
+        fanned out to non-owners). ``redistribute='allgather'`` keeps
+        the legacy exchange — every departing leaf state allgathered to
+        the whole cohort, every new owner picking what it needs (lowest
         contributing rank wins ties — all copies are bitwise identical
-        anyway). Runs on every wire member at the same step — the
-        generation bump is cohort-synchronized — so the collective is
-        always matched."""
+        anyway) — as the A/B arm. Either way it runs on every wire
+        member at the same step — the generation bump is
+        cohort-synchronized — so the collectives are always matched."""
         mgr = self.manager
         gen_fn = getattr(mgr, "wire_generation", None)
         gen = int(gen_fn()) if callable(gen_fn) else 0
@@ -336,12 +370,47 @@ class ShardedOptimizerWrapper:
         n_leaves = len(opt_state.leaf_states)
         owned = set(plan.owned_leaves(my_rank))
         held = set(opt_state.held())
-        outgoing = sorted(held - owned)
-        gathered = None
-        if world > 1:
-            # Contribution: [outgoing indices (i64)] + each outgoing
-            # leaf's flattened state arrays, in index order. Variable
-            # layouts per rank are allgather's normal use.
+        # available: adoptable leaf states that arrived off the wire;
+        # wire_bytes: what the exchange actually RECEIVED (the A/B
+        # surface — the planned arm receives exactly the lower bound,
+        # the legacy arm receives every other rank's departures);
+        # lower_bound: bytes of owned-but-missing leaves some survivor
+        # holds — the set-theoretic minimum any correct exchange moves.
+        available: "Dict[int, List[np.ndarray]]" = {}
+        wire_bytes = 0
+        lower_bound = 0
+        if world > 1 and self._redistribute == "plan":
+            import jax
+
+            from torchft_tpu.checkpointing import redistribute_exchange
+
+            # Holdings stay DEVICE arrays: the exchange reads only
+            # nbytes metadata from them, and the serve side stages
+            # lazily — a leaf pays its device-to-host copy exactly when
+            # a receiver actually fetches it (the legacy arm's
+            # outgoing-only materialization, generalized).
+            holdings = {
+                i: jax.tree_util.tree_leaves(opt_state.leaf_states[i])
+                for i in sorted(held)
+            }
+            result = redistribute_exchange(
+                mgr, my_rank, world, plan.shard_spec(), holdings,
+                self._planner, source="reshard",
+            )
+            if result is None:
+                # Latched wire / transfer failed whole: keep the old
+                # grid — this step discards, and the next healthy
+                # quorum's generation bump retries the exchange.
+                return opt_state
+            available = result.fetched
+            wire_bytes = result.moved_bytes
+            lower_bound = result.lower_bound_bytes
+        elif world > 1:
+            # Legacy allgather exchange (the A/B arm): contribution is
+            # [outgoing indices (i64)] + each outgoing leaf's flattened
+            # state arrays, in index order. Variable layouts per rank
+            # are allgather's normal use.
+            outgoing = sorted(held - owned)
             contrib: "List[np.ndarray]" = [
                 np.asarray(outgoing, dtype=np.int64)
             ]
@@ -353,15 +422,13 @@ class ShardedOptimizerWrapper:
             gathered = work.future().result()
             errored = getattr(mgr, "errored", None)
             if callable(errored) and errored() is not None:
-                # The exchange fell back (latched transport): keep the
-                # old grid — this step discards, and the next quorum's
-                # generation bump retries the exchange.
                 return opt_state
-        # Index every contributed leaf state (lowest rank wins).
-        available: "Dict[int, List[np.ndarray]]" = {}
-        if gathered is not None:
+            # Index every contributed leaf state (lowest rank wins);
+            # foreign payload bytes are what this arm put on the wire
+            # FOR this rank regardless of need — the waste the planner
+            # exists to avoid.
             k = self._state_slots
-            for rank_arrays in gathered:
+            for r, rank_arrays in enumerate(gathered):
                 if not rank_arrays:
                     continue
                 idx = np.asarray(rank_arrays[0]).astype(np.int64).reshape(-1)
@@ -371,8 +438,18 @@ class ShardedOptimizerWrapper:
                         np.asarray(a) for a in rank_arrays[pos: pos + k]
                     ]
                     pos += k
+                    if r != my_rank:
+                        wire_bytes += sum(int(a.nbytes) for a in slot)
                     if int(i) not in available:
                         available[int(i)] = slot
+            lower_bound = sum(
+                sum(int(a.nbytes) for a in available[i])
+                for i in owned - held if i in available
+            )
+            metrics = self._metrics()
+            if metrics is not None:
+                metrics.incr("redist_moved_bytes", float(wire_bytes))
+                metrics.incr("redist_lower_bound_bytes", float(lower_bound))
         new_states: "List[Any]" = [None] * n_leaves
         moved_bytes = 0
         kept = 0
@@ -413,7 +490,10 @@ class ShardedOptimizerWrapper:
                 "reshard",
                 old_world=opt_state.world_size or None,
                 new_world=world, rank=my_rank,
-                moved_bytes=moved_bytes, kept_leaves=kept,
+                moved_bytes=moved_bytes,
+                wire_bytes=wire_bytes,
+                lower_bound_bytes=lower_bound,
+                kept_leaves=kept,
                 reinit_leaves=len(reinit),
                 owned_leaves=len(owned),
             )

@@ -37,8 +37,15 @@ Event vocabulary (producers in parentheses):
     reshard                          (optim.py/local_sgd.py: sharded
                                       optimizer state redistributed at a
                                       quorum boundary — old/new worlds,
-                                      moved/kept byte counts and any
-                                      reinitialized leaves attached)
+                                      moved/wire/lower-bound byte counts
+                                      and any reinitialized leaves
+                                      attached)
+    redist_plan                      (comm/redistribute.py /
+                                      checkpointing.py: a redistribution
+                                      transfer plan executed — spec
+                                      fingerprints, cache hit/miss,
+                                      fetch/unsourced counts, moved vs
+                                      lower-bound bytes)
 
 Every event is stamped with a process-monotonic sequence number, wall +
 monotonic clocks, the bound replica_id/rank, and (when the emitter knows
@@ -93,6 +100,7 @@ EVENT_KINDS = (
     "hier_exchange",
     "shard_grid_rebuild",
     "reshard",
+    "redist_plan",
 )
 
 _DEFAULT_CAPACITY = 4096
