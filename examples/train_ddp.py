@@ -17,6 +17,13 @@ SHARDED=1 switches the weight update to the ZeRO-style cross-replica
 sharded path (reduce-scatter → 1/N optimizer update → params allgather:
 optimizer state/FLOPs/heal bytes ÷ wire world; docs/architecture.md
 "Sharded weight update"). The flag must match across replica groups.
+
+MODEL_SHARDS=M declares the 2-D replica×model mesh layout
+(docs/architecture.md "Fused step"): the manager labels its telemetry
+`mesh_shape="{world}x{M}"` (fleet_top renders it per replica) and the
+sharded wrapper prices reshards/heals on the (replica-shard ×
+model-shard) sub-unit grid — moved bytes stay at the set-theoretic
+minimum at any M. Like SHARDED, it must match across replica groups.
 """
 
 from __future__ import annotations
@@ -87,6 +94,10 @@ def main() -> None:
     # its 1/N shard; the healer reshards onto the live grid) — the
     # wrapper is bound below, after the Manager exists.
     sharded = os.environ.get("SHARDED", "0") == "1"
+    # MODEL_SHARDS=M: 2-D mesh layout knob — the Manager carries it
+    # (mesh_shape telemetry label, re-asserted every quorum) and the
+    # sharded wrapper reads it back for 2-D reshard pricing.
+    model_shards = int(os.environ.get("MODEL_SHARDS", "1"))
 
     def load_state_dict(sd):
         train = dict(sd["train"])
@@ -124,6 +135,7 @@ def main() -> None:
         world_size=world_size,
         store_addr=store_addr,
         replica_id=f"train_ddp_{replica_group}_",
+        model_shards=model_shards,
     )
     if sharded:
         from torchft_tpu import ShardedOptimizerWrapper

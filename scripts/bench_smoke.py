@@ -760,6 +760,123 @@ def redist_smoke() -> "list[str]":
     return failures
 
 
+_FUSED_SMOKE = r"""
+import json, sys
+import numpy as np
+sys.path.insert(0, sys.argv[1])
+import jax.numpy as jnp
+import optax
+from torchft_tpu.comm.xla_backend import MeshManager
+from torchft_tpu.fused import FusedStepEngine
+from torchft_tpu.utils.metrics import Metrics
+
+rng = np.random.default_rng(5)
+params = rng.standard_normal(777).astype(np.float32)
+
+def loss_fn(w, b):
+    return 0.5 * jnp.sum((w - jnp.mean(b)) ** 2)
+
+def mk(mm):
+    return FusedStepEngine(
+        mm, 2, 2, params, 8, loss_fn,
+        optax.sgd(0.05, momentum=0.9), codec="int8",
+        chunk_bytes=256, metrics=Metrics(),
+    )
+
+payload = {"errors": []}
+try:
+    mm = MeshManager()
+    fused, staged = mk(mm), mk(mm)
+    batch = rng.standard_normal((4, 8)).astype(np.float32)
+    lf = fused.step_fused(batch)
+    ls = staged.step_staged(batch)
+    payload["loss_fused"] = float(lf)
+    payload["loss_staged"] = float(ls)
+    payload["bitwise"] = fused.digest() == staged.digest()
+    payload["counters"] = fused.counters()
+    compiles_seen = mm.compile_count
+    fused.step_fused(rng.standard_normal((4, 8)).astype(np.float32))
+    payload["compiles_seen_shape_delta"] = mm.compile_count - compiles_seen
+except Exception as e:
+    payload["errors"].append(repr(e))
+print(json.dumps(payload))
+"""
+
+
+def fused_smoke() -> "list[str]":
+    """One in-process 2x2 forced-host-device fused step round (the
+    ISSUE 16 gate): fails on step_dispatch_count != 1, host hops != 0,
+    missing/non-finite loss gauges, compile growth on a repeated mesh
+    shape, or a staged<->fused bitwise mismatch."""
+    import math
+
+    env = {
+        k: v for k, v in os.environ.items() if k not in ("PYTHONPATH",)
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    out = None
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _FUSED_SMOKE, _REPO],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, timeout=300,
+        )
+        payload = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        stderr = getattr(e, "stderr", None)
+        if stderr is None and out is not None:
+            stderr = out.stderr
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+        tail = (stderr or "").strip()[-2000:]
+        suffix = f"\n  child stderr: {tail}" if tail else ""
+        return [
+            f"fused smoke: child failed to produce JSON: {e!r}{suffix}"
+        ]
+    failures = [f"fused smoke: {e}" for e in payload.get("errors", [])]
+    if failures:
+        return failures
+    c = payload.get("counters", {})
+    if c.get("step_dispatch_count") != 1:
+        failures.append(
+            "fused smoke: fused step must be exactly ONE dispatch, got "
+            f"{c.get('step_dispatch_count')!r}"
+        )
+    if c.get("step_host_hops") != 0:
+        failures.append(
+            f"fused smoke: fused step hopped the host "
+            f"{c.get('step_host_hops')!r} times (expected 0)"
+        )
+    if c.get("step_executable_count") != 1 or c.get("mesh_shape") != "2x2":
+        failures.append(
+            "fused smoke: executable gauge/mesh label wrong: "
+            f"executables={c.get('step_executable_count')!r} "
+            f"mesh={c.get('mesh_shape')!r}"
+        )
+    for key in ("loss_fused", "loss_staged"):
+        v = payload.get(key)
+        if v is None or not math.isfinite(float(v)):
+            failures.append(
+                f"fused smoke: gauge {key!r} missing/non-finite: {v!r}"
+            )
+    if payload.get("compiles_seen_shape_delta") != 0:
+        failures.append(
+            "fused smoke: a second step at a SEEN mesh shape compiled "
+            f"{payload.get('compiles_seen_shape_delta')!r} more "
+            "executables (expected a pure cache lookup)"
+        )
+    if payload.get("bitwise") is not True:
+        failures.append(
+            "fused smoke: staged and fused arms diverged bitwise on the "
+            "same batch"
+        )
+    return failures
+
+
 def fleet_smoke() -> "list[str]":
     """One in-process 32-group control-plane sweep point (the ISSUE 10
     gate): real HTTP against a live cached-quorum lighthouse plus the
@@ -868,6 +985,7 @@ def main() -> int:
     failures += events_smoke()
     failures += sharded_smoke()
     failures += redist_smoke()
+    failures += fused_smoke()
     failures += fleet_smoke()
     for key in ("t1_pipeline_overlap", "t1_pipeline_ms", "t1_ddp_streamed",
                 "t1_overhead_ms", "t1_outer_overlap", "t1_outer_wire_ms",
@@ -926,7 +1044,7 @@ def main() -> int:
         f"opt_state_ratio={(payload.get('sharded') or {}).get('state_bytes_ratio')} "
         "heal_gauges=ok outer_gauges=ok xla_gauges=ok qpsum_gauges=ok "
         "hier_gauges=ok chrome_trace=ok sharded_gauges=ok "
-        "redist_gauges=ok fleet_gauges=ok"
+        "redist_gauges=ok fused_gauges=ok fleet_gauges=ok"
     )
     return 0
 

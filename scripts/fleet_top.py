@@ -170,6 +170,8 @@ def build_row(ep: Dict[str, Any],
         "rank": ep.get("rank", 0),
         "step": ep.get("step"),
         "epoch": None,
+        "mesh": None,
+        "mode": None,
         "committed": None,
         "discarded": None,
         "allreduce_p50_ms": None,
@@ -190,6 +192,17 @@ def build_row(ep: Dict[str, Any],
     row["epoch"] = tel.get("epoch")
     if tel.get("healing"):
         row["replica"] += " (healing)"
+    # 2-D mesh layout + step-arm mode (ISSUE 16): mesh_shape is the
+    # "{replicas}x{model_shards}" label the manager re-asserts at every
+    # quorum; step_executable_count is the fused-step plane's per-step
+    # executable gauge — exactly 1 means the fused single-executable
+    # arm, ≥2 the staged A/B arm with host hops between dispatches.
+    mesh = m.get("mesh_shape")
+    if mesh is not None:
+        row["mesh"] = str(mesh).replace("x", "×")
+    execs = m.get("step_executable_count")
+    if execs is not None:
+        row["mode"] = "fused" if float(execs) <= 1 else "staged"
     row["committed"] = m.get("steps_committed")
     row["discarded"] = m.get("steps_discarded")
     row["allreduce_p50_ms"] = m.get("allreduce_p50_ms")
@@ -235,6 +248,7 @@ def build_row(ep: Dict[str, Any],
 
 _COLUMNS = (
     ("replica", 34), ("rank", 4), ("step", 6), ("epoch", 5),
+    ("mesh", 5), ("mode", 6),
     ("committed", 9), ("discarded", 9), ("allreduce_p50_ms", 16),
     ("heal_mb_s", 9), ("ddp_overlap", 11), ("outer_overlap", 13),
     ("d_intra_mb", 10), ("d_inter_mb", 10), ("redist_waste_mb", 15),

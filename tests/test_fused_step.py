@@ -1,0 +1,194 @@
+"""Fused single-executable step: bitwise identity + the counter oracle.
+
+The conftest forces an 8-device virtual CPU platform, so the fused step
+runs its real 2-D shard_map program here. The two load-bearing suites:
+
+* **Staged↔fused bitwise identity** — the staged arm (four executables,
+  real host round-trips between them) composes the SAME stage bodies
+  the fused program fuses; for every codec {none, bf16, int8+EF} at
+  both a genuinely 2-D shape (2×2) and a degenerate-model shape (4×1),
+  the full device state (params, EF residual, optimizer leaves) must
+  match sha256-for-sha256 after every step, cross-rank verified.
+
+* **Counter oracle** — fused = exactly 1 dispatch and 0 host hops per
+  step (staged = 4 and 6); exactly one compile on first sight of a
+  (mesh shape, codec); 0 retraces across a kill→shrink→rejoin cycle at
+  seen shapes. All pinned on ``MeshManager.compile_count`` /
+  ``trace_count`` and the step counters — never wall-clock.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from torchft_tpu.comm.xla_backend import MeshManager
+from torchft_tpu.fused import FusedStepEngine
+from torchft_tpu.utils.events import EventRecorder
+from torchft_tpu.utils.metrics import Metrics
+
+PARAMS = 13   # deliberately indivisible: exercises padding
+BATCH = 4
+CHUNK = 32    # several int8 chunks per q_len
+
+
+@pytest.fixture(scope="module")
+def mesh_mgr():
+    # One pool for the whole module: executables cache across tests,
+    # like one training process surviving many quorum epochs.
+    return MeshManager()
+
+
+def _loss_fn():
+    import jax.numpy as jnp
+
+    def loss_fn(w, b):
+        return 0.5 * jnp.sum((w - jnp.mean(b)) ** 2)
+
+    return loss_fn
+
+
+def _tx():
+    import optax
+
+    return optax.sgd(0.05, momentum=0.9)
+
+
+def _engine(mesh_mgr, replicas, model_shards, codec, **kw):
+    rng = np.random.default_rng(7)
+    params = rng.standard_normal(PARAMS).astype(np.float32)
+    return FusedStepEngine(
+        mesh_mgr, replicas, model_shards, params, BATCH,
+        _loss_fn(), _tx(), codec=codec, chunk_bytes=CHUNK, **kw,
+    )
+
+
+def _batch(devices: int, step: int) -> np.ndarray:
+    rng = np.random.default_rng(100 + step)
+    return rng.standard_normal((devices, BATCH)).astype(np.float32)
+
+
+# ------------------------------------------------ bitwise identity
+
+
+@pytest.mark.parametrize("shape", [(2, 2), (4, 1)],
+                         ids=["2x2", "4x1"])
+@pytest.mark.parametrize("codec", ["none", "bf16", "int8"])
+def test_staged_fused_bitwise_identity(mesh_mgr, shape, codec) -> None:
+    R, M = shape
+    a = _engine(mesh_mgr, R, M, codec)
+    b = _engine(mesh_mgr, R, M, codec)
+    assert a.digest() == b.digest()  # identical initial state
+    for step in range(3):
+        batch = _batch(R * M, step)
+        la = a.step_fused(batch)
+        lb = b.step_staged(batch)
+        assert np.isfinite(la)
+        assert np.float32(la) == np.float32(lb)
+        assert a.digest() == b.digest(), (
+            f"state diverged at step {step} ({codec} {R}x{M})"
+        )
+    # cross-rank: every replica row of a model shard holds identical
+    # params bytes (the replica allgather ships raw bytes)
+    a.verify_replicas()
+    b.verify_replicas()
+    # int8 must actually run the EF arm
+    assert a.spec.error_feedback == (codec == "int8")
+    if codec == "int8":
+        assert np.any(np.asarray(a._e) != 0.0)
+
+
+def test_padding_roundtrip(mesh_mgr) -> None:
+    # params() returns exactly the original (unpadded) extent
+    eng = _engine(mesh_mgr, 2, 2, "none")
+    assert eng.params().shape == (PARAMS,)
+    assert eng.spec.q_len * 4 >= PARAMS
+
+
+# ------------------------------------------------- counter oracle
+
+
+def test_fused_counter_oracle() -> None:
+    mm = MeshManager()
+    metrics = Metrics()
+    eng = _engine(mm, 2, 2, "int8", metrics=metrics)
+    eng.step_fused(_batch(4, 0))
+    c = eng.counters()
+    assert c["step_dispatch_count"] == 1
+    assert c["step_host_hops"] == 0
+    assert c["step_executable_count"] == 1
+    assert c["mesh_shape"] == "2x2"
+    # exactly ONE compile on first sight of (mesh shape, codec)
+    assert mm.compile_count == 1
+    assert mm.trace_count == 1
+    eng.step_fused(_batch(4, 1))
+    assert eng.counters()["step_dispatch_count"] == 2
+    assert mm.compile_count == 1  # seen shape: lookup, never retrace
+    assert mm.hit_count >= 1
+
+
+def test_staged_counter_oracle() -> None:
+    mm = MeshManager()
+    eng = _engine(mm, 2, 2, "none", metrics=Metrics())
+    eng.step_staged(_batch(4, 0))
+    c = eng.counters()
+    assert c["step_dispatch_count"] == 4
+    assert c["step_host_hops"] == 6  # gm, h, new_sub × (d2h + h2d)
+    assert c["step_executable_count"] == 4
+    assert mm.compile_count == 4
+
+
+def test_fused_step_event_emitted() -> None:
+    mm = MeshManager()
+    ev = EventRecorder(replica_id="t", rank=0)
+    eng = _engine(mm, 2, 2, "bf16", events=ev)
+    eng.step_fused(_batch(4, 0))
+    eng.step_staged(_batch(4, 1))  # staged steps do NOT emit
+    kinds = [e["kind"] for e in ev.dump()["events"]]
+    assert kinds.count("fused_step") == 1
+    rec = [e for e in ev.dump()["events"] if e["kind"] == "fused_step"][0]
+    assert rec["mesh_shape"] == "2x2"
+    assert rec["codec"] == "bf16"
+    assert rec["dispatches"] == 1
+    assert rec["executables"] == 1
+    # captured at emit time: only the fused executable existed yet
+    assert rec["compile_count"] == 1
+
+
+def test_no_retrace_across_kill_shrink_rejoin() -> None:
+    # kill→shrink→rejoin at seen shapes costs ZERO compiles/retraces:
+    # the executables for both shapes stay cached in the MeshManager.
+    mm = MeshManager()
+    eng = _engine(mm, 4, 1, "int8")
+    eng.step_fused(_batch(4, 0))
+    compiles_4x1 = mm.compile_count
+    eng.reshape_mesh(2)          # two replicas died: shrink
+    eng.step_fused(_batch(2, 1))
+    compiles_both = mm.compile_count
+    assert compiles_both > compiles_4x1  # first sight of 2x1 compiles
+    traces_both = mm.trace_count
+    eng.reshape_mesh(4)          # they healed: rejoin at a seen shape
+    eng.step_fused(_batch(4, 2))
+    eng.reshape_mesh(2)          # and churn again
+    eng.step_fused(_batch(2, 3))
+    assert mm.compile_count == compiles_both
+    assert mm.trace_count == traces_both
+    assert eng.counters()["mesh_shape"] == "2x1"
+
+
+def test_mesh_shape_label_follows_reshape() -> None:
+    mm = MeshManager()
+    eng = _engine(mm, 2, 2, "none")
+    assert eng.metrics.snapshot()["mesh_shape"] == "2x2"
+    eng.reshape_mesh(2, 1)
+    assert eng.metrics.snapshot()["mesh_shape"] == "2x1"
+
+
+def test_reshape_preserves_params() -> None:
+    mm = MeshManager()
+    eng = _engine(mm, 2, 2, "none")
+    eng.step_fused(_batch(4, 0))
+    before = eng.params().copy()
+    eng.reshape_mesh(4, 1)
+    np.testing.assert_array_equal(before, eng.params())
+    eng.verify_replicas()

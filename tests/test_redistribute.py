@@ -81,6 +81,85 @@ def test_plan_minimal_no_overship_no_fanout() -> None:
     assert plan.serve_units(1) == (4,)
 
 
+def test_spec_2d_sub_units() -> None:
+    """from_ranges_2d: each base unit splits into model_shards opaque
+    sub-units (unit*M + m), all co-held with their base unit — the 2-D
+    (replica × model) grid the fused-step plane reshards through with
+    ZERO engine changes."""
+    spec = ShardSpec.from_ranges_2d([(0, 2), (2, 3)], 2, 3)
+    assert spec.n_units == 6
+    assert spec.units_of(0) == (0, 1, 2, 3)   # leaves 0,1 × shards 0,1
+    assert spec.units_of(1) == (4, 5)         # leaf 2 × shards 0,1
+    # M=1 degenerates to the 1-D constructor exactly
+    assert (
+        ShardSpec.from_ranges_2d([(0, 2), (2, 3)], 1, 3)
+        == ShardSpec.from_ranges([(0, 2), (2, 3)], 3)
+    )
+    # explicit-assignment equivalence (sub-units are just units)
+    assert spec == ShardSpec(6, {0: [0, 1, 2, 3], 1: [4, 5]})
+
+
+def test_plan_2d_shrink_moved_equals_lower_bound() -> None:
+    """A w3→w2 shrink at model_shards=2 (the kill→shrink transition of
+    the 2-D mesh) prices per SUB-unit: moved == the set-theoretic lower
+    bound, dead-owner sub-units are unsourced (reinit), and a model
+    shard is never overshipped with its sibling."""
+    sizes = [8 + i for i in range(6)]
+    dtypes = [np.dtype(np.float32)] * 6
+    M = 2
+    spec3 = ShardSpec.from_ranges_2d(shard_ranges(sizes, dtypes, 3), M, 6)
+    spec2 = ShardSpec.from_ranges_2d(shard_ranges(sizes, dtypes, 2), M, 6)
+    # old rank 0 died: survivors (old 1, 2) relabel to new ranks (0, 1)
+    src = ShardSpec(6 * M, {0: spec3.units_of(1), 1: spec3.units_of(2)})
+    # per-sub-unit bytes: contiguous split of each leaf's flat payload
+    unit_bytes = []
+    for n in sizes:
+        half = (n // M) * 4
+        unit_bytes.extend([half, n * 4 - half])
+    plan = TransferPlan(src, spec2, unit_bytes)
+    assert plan.lower_bound_bytes == plan.moved_bytes
+    for rank in (0, 1):
+        needed = set(spec2.units_of(rank)) - set(src.units_of(rank))
+        sourced = {u for u in needed if src.holders_of(u)}
+        assert {u for u, _ in plan.receiver_fetches(rank)} == sourced
+        assert set(plan.receiver_unsourced(rank)) == needed - sourced
+        assert plan.moved_bytes.get(rank, 0) == sum(
+            unit_bytes[u] for u in sourced
+        )
+    # the transition must actually exercise both outcomes
+    assert any(plan.receiver_fetches(r) for r in (0, 1))
+    assert any(plan.receiver_unsourced(r) for r in (0, 1))
+
+
+def test_split_join_leaf_payload_roundtrip() -> None:
+    """checkpointing.split_leaf_payload / join_leaf_payload: the 2-D
+    holdings shaping is a lossless inverse pair, including scalar and
+    odd-length slots whose remainder lands on the LAST shard."""
+    from torchft_tpu.checkpointing import (
+        join_leaf_payload,
+        split_leaf_payload,
+    )
+
+    rng = np.random.default_rng(3)
+    arrays = [
+        np.asarray(np.int32(7)),                  # scalar slot (count)
+        rng.standard_normal(13).astype(np.float32),
+        rng.standard_normal((3, 5)).astype(np.float32),
+    ]
+    for m in (1, 2, 3, 4):
+        pieces = split_leaf_payload(arrays, m)
+        assert len(pieces) == m
+        back = join_leaf_payload(pieces, [a.shape for a in arrays])
+        for orig, rt in zip(arrays, back):
+            assert orig.dtype == rt.dtype
+            np.testing.assert_array_equal(orig, rt)
+    # byte mismatch → ValueError (the reinit-adoption contract)
+    bad = split_leaf_payload(arrays, 2)
+    bad[1][1] = bad[1][1][:-1]
+    with pytest.raises(ValueError, match="template"):
+        join_leaf_payload(bad, [a.shape for a in arrays])
+
+
 def test_plan_cache_oscillation_exactly_two_builds() -> None:
     """w2→w3→w2→w3 over real shard grids: exactly 2 plan builds (one
     per direction), the rest cache hits — the spec-pair cache

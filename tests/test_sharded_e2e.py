@@ -68,11 +68,13 @@ class _Replica:
 
     def __init__(self, replica_id: int, lighthouse_addr: str,
                  harness: _Harness,
-                 fail_at_step: Optional[int] = None) -> None:
+                 fail_at_step: Optional[int] = None,
+                 model_shards: int = 1) -> None:
         self.replica_id = replica_id
         self.lighthouse_addr = lighthouse_addr
         self.harness = harness
         self.fail_at_step = fail_at_step
+        self.model_shards = model_shards
         self.failures = 0
         self.telemetry: List[dict] = []
 
@@ -139,6 +141,7 @@ class _Replica:
             lighthouse_addr=self.lighthouse_addr,
             replica_id=f"sharded_rep_{self.replica_id}_",
             heartbeat_interval=0.05,
+            model_shards=self.model_shards,
         )
         opt = ShardedOptimizerWrapper(
             manager, optax.adam(1e-2),
@@ -277,3 +280,123 @@ def test_sharded_kill_shrink_rejoin_lifecycle() -> None:
         and e["seq"] > heal_done[0]["seq"]
     ]
     assert rj_commits, "the rejoiner never committed after healing"
+
+
+def _sub_unit_bytes(model_shards: int) -> List[int]:
+    """Per-sub-unit byte sizes of the harness's adam states: leaf i has
+    an (8+i,) param, its state splits into model_shards contiguous
+    payloads exactly as optim.py ships them."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from torchft_tpu.checkpointing import split_leaf_payload
+
+    tx = optax.adam(1e-2)
+    out: List[int] = []
+    for i in range(6):
+        arrays = [
+            np.asarray(a) for a in jax.tree_util.tree_leaves(
+                tx.init(jnp.zeros((8 + i,), jnp.float32))
+            )
+        ]
+        for shard in split_leaf_payload(arrays, model_shards):
+            out.append(sum(int(a.nbytes) for a in shard))
+    return out
+
+
+def test_sharded_2d_kill_shrink_rejoin_lower_bound() -> None:
+    """ISSUE 16 satellite: kill → shrink on the REPLICA axis at a fixed
+    model axis (model_shards=2) → rejoin. The shrink reshard must move
+    exactly the PR 14 set-theoretic lower bound for the 2-D spec
+    transition — reconstructed from the ``/telemetry/events`` endpoints
+    ALONE: each survivor's old/new ranks come from its own reshard
+    events, the 2-D specs from the deterministic shard grid, and the
+    event's wire/lower-bound byte counts must equal the independently
+    computed ``TransferPlan`` bound."""
+    from torchft_tpu.comm.redistribute import ShardSpec, TransferPlan
+    from torchft_tpu.ddp import shard_ranges
+
+    M = 2
+    lighthouse = Lighthouse(
+        min_replicas=1, join_timeout_ms=200, heartbeat_timeout_ms=1000
+    )
+    harness = _Harness(num_replicas=3, total_steps=8)
+    replicas = [
+        _Replica(0, lighthouse.address(), harness, fail_at_step=3,
+                 model_shards=M),
+        _Replica(1, lighthouse.address(), harness, model_shards=M),
+        _Replica(2, lighthouse.address(), harness, model_shards=M),
+    ]
+    try:
+        with ThreadPoolExecutor(max_workers=3) as pool:
+            futs = [pool.submit(r.run) for r in replicas]
+            deadline = time.monotonic() + 180.0
+            for f in futs:
+                f.result(timeout=max(1.0, deadline - time.monotonic()))
+    finally:
+        harness.stop.set()
+        lighthouse.shutdown()
+
+    assert replicas[0].failures == 1
+
+    # -- per-survivor rank history, from events alone -------------------
+    def _rank_at(events: List[dict], world: int) -> int:
+        resh = [
+            e for e in events
+            if e["kind"] == "reshard" and e.get("new_world") == world
+        ]
+        assert resh, f"no reshard onto the {world}-wire grid"
+        return int(resh[0]["rank"])
+
+    surv_events = {
+        rid: _events_of(replicas[rid].telemetry[-1]) for rid in (1, 2)
+    }
+    old_rank = {rid: _rank_at(ev, 3) for rid, ev in surv_events.items()}
+    new_rank = {rid: _rank_at(ev, 2) for rid, ev in surv_events.items()}
+    assert sorted(new_rank.values()) == [0, 1]
+
+    # -- the independent 2-D pricing ------------------------------------
+    sizes = [8 + i for i in range(6)]
+    dtypes = [np.dtype(np.float32)] * 6
+    spec3 = ShardSpec.from_ranges_2d(
+        shard_ranges(sizes, dtypes, 3), M, 6
+    )
+    spec2 = ShardSpec.from_ranges_2d(
+        shard_ranges(sizes, dtypes, 2), M, 6
+    )
+    src = ShardSpec(6 * M, {
+        new_rank[rid]: spec3.units_of(old_rank[rid]) for rid in (1, 2)
+    })
+    plan = TransferPlan(src, spec2, _sub_unit_bytes(M))
+    assert plan.lower_bound_bytes == plan.moved_bytes
+
+    for rid in (1, 2):
+        shrink = [
+            e for e in surv_events[rid]
+            if e["kind"] == "reshard" and e.get("new_world") == 2
+        ][0]
+        expected = plan.lower_bound_bytes.get(new_rank[rid], 0)
+        assert shrink["mesh_shape"] == f"2x{M}"
+        assert shrink["lower_bound_bytes"] == expected, (
+            f"survivor {rid}: event bound {shrink['lower_bound_bytes']} "
+            f"!= independently priced 2-D bound {expected}"
+        )
+        # the planned arm RECEIVES exactly the bound, never more
+        assert shrink["wire_bytes"] == expected
+        # dead-owner sub-units reinit whole leaves (M sub-units each)
+        unsourced = plan.receiver_unsourced(new_rank[rid])
+        assert shrink["reinit_leaves"] == len(unsourced) // M
+        # every executed transfer plan was minimal, per its own event
+        for e in surv_events[rid]:
+            if e["kind"] == "redist_plan":
+                assert e["moved_bytes"] == e["lower_bound_bytes"]
+
+    # the transition must genuinely exercise the 2-D pricing: someone
+    # fetched sub-units, and someone reinitialized a dead slice
+    assert any(
+        plan.moved_bytes.get(new_rank[rid], 0) > 0 for rid in (1, 2)
+    )
+    assert any(
+        plan.receiver_unsourced(new_rank[rid]) for rid in (1, 2)
+    )

@@ -429,6 +429,44 @@ def test_fleet_top_rows_from_live_endpoint() -> None:
         server.shutdown()
 
 
+def test_fleet_top_mesh_and_mode_columns_live() -> None:
+    # ISSUE 16: the mesh column is the manager's "{replicas}x{model}"
+    # label; mode derives from the fused plane's step_executable_count
+    # gauge (1 = fused single-executable arm, >=2 = staged A/B arm).
+    ft = _load_fleet_top()
+    server = CheckpointServer(timeout=5.0)
+    metrics = Metrics()
+    server.set_metrics(metrics)
+    server.set_telemetry(lambda: {
+        "replica_id": "rep_m", "rank": 0, "step": 3, "healing": False,
+    })
+    try:
+        metrics.label("mesh_shape", "2x2")
+        metrics.gauge("step_executable_count", 1.0)
+        ep = {"replica_id": "rep_m", "rank": 0, "url": server.metadata()}
+        row = ft.build_row(
+            ep, ft.poll_manager(server.metadata(), 0, timeout=5.0)
+        )
+        assert row["mesh"] == "2×2"
+        assert row["mode"] == "fused"
+        text = ft.render({"quorum": {"participants": [{}]}}, [row])
+        assert "2×2" in text and "fused" in text
+        # staged arm: four executables dispatched per step
+        metrics.gauge("step_executable_count", 4.0)
+        row2 = ft.build_row(
+            ep, ft.poll_manager(server.metadata(), 0, timeout=5.0)
+        )
+        assert row2["mode"] == "staged"
+        # a replica that never ran the fused plane renders "-", no crash
+        bare = ft.build_row(
+            ep, {"metrics": {"metrics": {}}, "events": {"events": []}}
+        )
+        assert bare["mesh"] is None and bare["mode"] is None
+        assert "rep_m" in ft.render({}, [bare])
+    finally:
+        server.shutdown()
+
+
 # ------------------------------------------------------------------ satellites
 
 

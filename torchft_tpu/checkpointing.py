@@ -2109,6 +2109,60 @@ def redistribute_exchange(
     )
 
 
+def split_leaf_payload(
+    arrays: "Sequence[Any]", model_shards: int
+) -> "List[List[np.ndarray]]":
+    """Split one redistribution unit's slot arrays into ``model_shards``
+    sub-unit payloads — the 2-D mesh's holdings shape. Each slot array
+    is raveled and cut into ``model_shards`` contiguous pieces (piece
+    ``m`` of every slot → sub-unit ``m``), so sub-unit ``leaf * M + m``
+    carries exactly the bytes device column ``m`` owns. Slots whose
+    flat length does not divide evenly put the remainder on the LAST
+    shard (deterministic, mirrored by :func:`join_leaf_payload`)."""
+    m = max(1, int(model_shards))
+    out: "List[List[np.ndarray]]" = [[] for _ in range(m)]
+    for a in arrays:
+        flat = np.ascontiguousarray(a).ravel()
+        step = len(flat) // m
+        for s in range(m):
+            lo = s * step
+            hi = (s + 1) * step if s < m - 1 else len(flat)
+            out[s].append(flat[lo:hi])
+    return out
+
+
+def join_leaf_payload(
+    pieces_by_shard: "Sequence[Sequence[Any]]",
+    template_shapes: "Sequence[Tuple[int, ...]]",
+) -> "List[np.ndarray]":
+    """Inverse of :func:`split_leaf_payload`: reassemble a unit's slot
+    arrays from its ``model_shards`` sub-unit payloads, restoring the
+    shapes of ``template_shapes`` (one per slot). Raises ``ValueError``
+    when the received bytes cannot fill a template — the caller treats
+    that unit as missing and reinitializes (the reshard adoption
+    contract)."""
+    n_slots = len(template_shapes)
+    for shard in pieces_by_shard:
+        if len(shard) != n_slots:
+            raise ValueError(
+                f"sub-unit carries {len(shard)} slots, expected {n_slots}"
+            )
+    out: "List[np.ndarray]" = []
+    for i, shape in enumerate(template_shapes):
+        flat = np.concatenate([
+            np.ascontiguousarray(shard[i]).ravel()
+            for shard in pieces_by_shard
+        ]) if pieces_by_shard else np.empty((0,))
+        want = int(np.prod(shape)) if shape else 1
+        if flat.size != want:
+            raise ValueError(
+                f"slot {i}: reassembled {flat.size} elements, template "
+                f"shape {tuple(shape)} needs {want}"
+            )
+        out.append(flat.reshape(shape))
+    return out
+
+
 def _recv_chunked(
     metadata: str, step: int, num_chunks: int, timeout: float,
     metrics: "Optional[Any]" = None,

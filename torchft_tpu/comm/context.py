@@ -25,7 +25,7 @@ import threading
 from abc import ABC, abstractmethod
 from concurrent.futures import Future
 from datetime import timedelta
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -272,6 +272,14 @@ class CommContext(ABC):
         actually carries, for bandwidth/compression-ratio gauges.
         Identity wire: the raw byte count."""
         return int(np.asarray(a).nbytes)
+
+    def mesh_shape(self) -> "Tuple[int, int]":
+        """(replicas, model_shards) of the device layout behind this
+        context. Host/wire contexts are 1-D by construction — one
+        device per replica group — so the default reports the wire
+        world with a degenerate model axis; the xla plane overrides
+        with its 2-D mesh (comm/xla_backend.py)."""
+        return (self.world_size(), 1)
 
 
 class DummyCommContext(CommContext):

@@ -142,6 +142,29 @@ class ShardSpec:
         })
 
     @classmethod
+    def from_ranges_2d(cls, ranges: "Sequence[Tuple[int, int]]",
+                       model_shards: int,
+                       n_units: "Optional[int]" = None) -> "ShardSpec":
+        """A (replica-shard × model-shard) grid: each base unit ``u`` of
+        a 1-D contiguous grid splits into ``model_shards`` sub-units
+        ``u * model_shards + m``, all held by the replica rank that
+        holds ``u``. This is how the 2-D mesh's optimizer state prices
+        through the planner with ZERO engine changes — sub-units are
+        just more (opaque) units, so a heal/reshard at a changed world
+        size or mesh shape compiles to the same provably-minimal
+        transfer plan as the 1-D case. ``n_units`` is the BASE grid
+        extent (defaults to the ranges' extent); the returned spec has
+        ``n_units * model_shards`` units."""
+        m = max(1, int(model_shards))
+        ranges = [(int(a), int(b)) for a, b in ranges]
+        if n_units is None:
+            n_units = max((b for _, b in ranges), default=0)
+        return cls(int(n_units) * m, {
+            r: [u * m + s for u in range(a, b) for s in range(m)]
+            for r, (a, b) in enumerate(ranges)
+        })
+
+    @classmethod
     def from_owner_map(cls, n_units: int, world: int,
                        owner_fn: "Callable[[int], int]") -> "ShardSpec":
         """An owner function over the unit grid (DiLoCo's

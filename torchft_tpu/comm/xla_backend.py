@@ -121,6 +121,11 @@ __all__ = [
 ]
 
 _AXIS = "replica"
+# Second mesh axis: intra-replica model sharding (HSDP — FSDP inside a
+# replica group x DDP across replicas). The WIRE collectives stay
+# 1-D (axis-scoped to "replica"); the fused step builders compose both
+# axes inside one executable (torchft_tpu/fused.py).
+_MODEL_AXIS = "model"
 
 # Dtypes the on-device path carries. f32 is the codec plane; the rest
 # pass through uncompressed (matching the host codecs' _is_compressible
@@ -158,10 +163,15 @@ class MeshManager:
     contexts (one per Manager in a test harness) hit one cache."""
 
     def __init__(self, devices: Optional[Sequence[Any]] = None,
-                 axis_name: str = _AXIS) -> None:
+                 axis_name: str = _AXIS,
+                 model_axis_name: str = _MODEL_AXIS) -> None:
         self._devices = tuple(devices) if devices is not None else None
         self.axis_name = axis_name
-        self._meshes: Dict[int, Any] = {}
+        self.model_axis_name = model_axis_name
+        # 1-D meshes keyed by int world_size (the wire plane — every
+        # existing executable key embeds that int, so the key space is
+        # stable); 2-D meshes keyed by (replicas, model_shards).
+        self._meshes: Dict[Any, Any] = {}
         self._execs: Dict[Tuple, Any] = {}
         self._building: Dict[Tuple, Future] = {}
         self._lock = threading.Lock()
@@ -196,22 +206,41 @@ class MeshManager:
     def device_count(self) -> int:
         return len(self.devices())
 
-    def mesh_for(self, world_size: int):
+    def mesh_for(self, world_size: int, model_shards: int = 1):
+        """Mesh over ``devices[:world_size * model_shards]``.
+
+        ``model_shards == 1`` (the wire plane) keeps the historical 1-D
+        ``("replica",)`` mesh under its int cache key — every existing
+        executable key and test pin is untouched. ``model_shards > 1``
+        builds the 2-D ``("replica", "model")`` mesh: replica group r is
+        the device ROW ``devices[r*M : (r+1)*M]``, so shrinking the
+        replica axis at a fixed model axis drops whole rows and every
+        surviving group keeps its device identity — the property that
+        makes churn at a seen (R, M) shape a cache lookup."""
         from jax.sharding import Mesh
 
+        m = max(1, int(model_shards))
         with self._lock:
-            mesh = self._meshes.get(world_size)
+            key: Any = world_size if m == 1 else (world_size, m)
+            mesh = self._meshes.get(key)
             if mesh is None:
                 devs = self.devices()
-                if world_size > len(devs):
+                need = world_size * m
+                if need > len(devs):
                     raise ValueError(
-                        f"world_size {world_size} exceeds the device pool "
-                        f"({len(devs)} devices); raise "
-                        "--xla_force_host_platform_device_count or pass a "
-                        "larger `devices` pool to MeshManager"
+                        f"mesh {world_size}x{m} needs {need} devices, "
+                        f"which exceeds the device pool ({len(devs)}); "
+                        "raise --xla_force_host_platform_device_count or "
+                        "pass a larger `devices` pool to MeshManager"
                     )
-                mesh = Mesh(devs[:world_size], (self.axis_name,))
-                self._meshes[world_size] = mesh
+                if m == 1:
+                    mesh = Mesh(devs[:world_size], (self.axis_name,))
+                else:
+                    mesh = Mesh(
+                        np.array(devs[:need]).reshape(world_size, m),
+                        (self.axis_name, self.model_axis_name),
+                    )
+                self._meshes[key] = mesh
             return mesh
 
     def executable(self, key: Tuple, build):
@@ -863,6 +892,393 @@ def _build_quantized_psum_scatter(mesh_mgr: MeshManager, world_size: int,
     ]
     with _x64_trace():
         return jax.jit(fn).lower(*avals).compile(), (rep, row)
+
+
+# --------------------------------------------------- fused step builders
+#
+# The HSDP step over the 2-D ("replica", "model") mesh: each replica
+# group is a row of model_shards devices; params are model-sharded and
+# replica-replicated, optimizer state is sharded over BOTH axes (each
+# device owns the (model shard, replica) sub-shard it updates). The
+# fused builder compiles params-allgather(model) → grad →
+# reduce-scatter(model) → [EF + encode →] exchange(replica) → sharded
+# update → params-allgather(replica) into ONE executable; the staged
+# builders compile the SAME local functions as four separate
+# executables with host round-trips between them (the live A/B arm).
+# _hardround at every stage boundary in BOTH arms is what makes
+# fused↔staged a BITWISE identity, not a numeric envelope — the PR 3/5/8
+# discipline. Cached in the MeshManager per (mesh shape, codec, chunk
+# grid, layouts, fn identity) like every PR 6 collective, so membership
+# churn at a seen shape is a cache lookup, never a retrace.
+
+
+class _FusedSpec:
+    """Static description of one fused-step program family — everything
+    the builders need to trace, and everything the executable cache key
+    must pin. ``q_len`` is the per-device owned sub-shard,
+    ``p_len = replicas * q_len`` the per-model-shard param slice,
+    ``s_len = model_shards * p_len`` the padded flat param vector."""
+
+    __slots__ = (
+        "replicas", "model_shards", "param_size", "batch_size",
+        "codec_name", "chunk_bytes", "quant_impl", "error_feedback",
+        "loss_fn", "tx", "opt_treedef", "opt_leaf_shapes",
+        "opt_leaf_dtypes", "fn_key", "q_len", "p_len", "s_len",
+    )
+
+    def __init__(self, replicas: int, model_shards: int, param_size: int,
+                 batch_size: int, codec_name: str, chunk_bytes: int,
+                 quant_impl: str, error_feedback: bool, loss_fn, tx,
+                 opt_treedef, opt_leaf_shapes, opt_leaf_dtypes,
+                 fn_key: str) -> None:
+        self.replicas = int(replicas)
+        self.model_shards = max(1, int(model_shards))
+        self.param_size = int(param_size)
+        self.batch_size = int(batch_size)
+        self.codec_name = codec_name
+        self.chunk_bytes = int(chunk_bytes)
+        self.quant_impl = quant_impl
+        self.error_feedback = bool(error_feedback)
+        self.loss_fn = loss_fn
+        self.tx = tx
+        self.opt_treedef = opt_treedef
+        self.opt_leaf_shapes = tuple(tuple(s) for s in opt_leaf_shapes)
+        self.opt_leaf_dtypes = tuple(opt_leaf_dtypes)
+        self.fn_key = fn_key
+        self.q_len = max(
+            1, -(-self.param_size // (self.replicas * self.model_shards))
+        )
+        self.p_len = self.replicas * self.q_len
+        self.s_len = self.model_shards * self.p_len
+
+    def exec_key(self, kind: str) -> Tuple:
+        """MeshManager executable-cache key for one program of the
+        family (``kind``: "fused" or a stage name): pins mesh shape,
+        codec, chunk grid, quantizer impl, EF arm, layouts and the
+        caller-supplied (loss_fn, tx) identity."""
+        return (
+            "fused_step", kind, self.replicas, self.model_shards,
+            self.codec_name, self.chunk_bytes, self.quant_impl,
+            self.error_feedback, self.param_size, self.batch_size,
+            self.opt_leaf_shapes,
+            tuple(str(d) for d in self.opt_leaf_dtypes), self.fn_key,
+        )
+
+
+def _fused_axes(mesh_mgr: MeshManager, spec: "_FusedSpec"):
+    """(mesh, dim-0 partition axes) for the spec's shape — 1-D when the
+    model axis is degenerate (4x1 style shapes), 2-D otherwise."""
+    mesh = mesh_mgr.mesh_for(spec.replicas, spec.model_shards)
+    if spec.model_shards == 1:
+        return mesh, (mesh_mgr.axis_name,)
+    return mesh, (mesh_mgr.axis_name, mesh_mgr.model_axis_name)
+
+
+def _fused_local_fns(mesh_mgr: MeshManager, spec: "_FusedSpec"):
+    """The four per-device stage bodies, defined ONCE and shared by the
+    fused and staged builders — identical traced code either side of
+    the _hardround stage fences is the bitwise-identity mechanism.
+
+    Values are LOCAL (unbatched): ``p`` the (p_len,) model-shard param
+    slice, ``b`` this device's microbatch, ``e`` the (p_len,) EF
+    residual, ``h`` the (q_len,) reduced owned sub-shard gradient."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    R, M = spec.replicas, spec.model_shards
+    q_len, p_len, s_len = spec.q_len, spec.p_len, spec.s_len
+    codec = spec.codec_name
+    axis = mesh_mgr.axis_name
+    maxis = mesh_mgr.model_axis_name
+    axes = (axis,) if M == 1 else (axis, maxis)
+    denom = np.float32(R * M)
+    ef = spec.error_feedback
+
+    def loss_body(full, b):
+        return spec.loss_fn(full[: spec.param_size], b)
+
+    def local_grad(z, p, b):
+        # params allgather over the model axis, per-microbatch grad,
+        # grad reduce-scatter back onto the model axis. AVG over the
+        # R*M device microbatches happens after the replica exchange.
+        full = lax.all_gather(p, maxis).reshape(s_len) if M > 1 else p
+        loss, g = jax.value_and_grad(loss_body)(full, b)
+        if M > 1:
+            g = lax.psum_scatter(
+                g.reshape(M, p_len), maxis, scatter_dimension=0,
+                tiled=False,
+            )
+        gm = _hardround(g, z)
+        loss = _hardround(lax.psum(loss, axes) / denom, z)
+        return gm, loss
+
+    def local_exchange(z, gm, e):
+        # cross-replica reduce-scatter of the model-sharded grad, with
+        # the wire codec applied exactly as the PR 11 quantized
+        # psum_scatter applies it (shared _quantize_chunks / chunk
+        # grid); int8 composes the error-feedback residual like the
+        # host arena (residual vs the wire image of OWN contribution).
+        if codec == "none":
+            h = lax.psum_scatter(
+                gm.reshape(R, q_len), axis, scatter_dimension=0,
+                tiled=False,
+            )
+            return _hardround(h / denom, z), e
+        if codec in ("bf16", "fp16"):
+            wd = jnp.bfloat16 if codec == "bf16" else jnp.float16
+            et = lax.all_to_all(
+                gm.reshape(R, q_len).astype(wd), axis, 0, 0
+            )
+            acc = jnp.zeros((q_len,), jnp.float32)
+            for r in range(R):
+                acc = _hardround(acc + et[r].astype(jnp.float32), z)
+            return _hardround(acc / denom, z), e
+        # int8 (+ EF): phase 1 of the EQuARX exchange on the replica
+        # axis — encode per destination slot on the PR 2 chunk grid,
+        # ship ENCODED bytes, dequantize-accumulate in rank order.
+        gq = _hardround(gm + e, z) if ef else gm
+        bounds = _grid_bounds(q_len, spec.chunk_bytes)
+        lens = np.array([b1 - b0 for b0, b1 in bounds])
+        rows = gq.reshape(R, q_len)
+        q_rows, s_rows, w_rows = [], [], []
+        for j in range(R):
+            q_j, s_j = _quantize_chunks(
+                rows[j], z, bounds, spec.quant_impl
+            )
+            q_rows.append(q_j)
+            s_rows.append(s_j)
+            if ef:
+                s_elem = jnp.repeat(
+                    s_j, jnp.asarray(lens), total_repeat_length=q_len
+                )
+                w_rows.append(_dev_dequant_int8(q_j, s_elem, z))
+        qt = lax.all_to_all(jnp.stack(q_rows), axis, 0, 0)
+        sc_all = lax.all_gather(jnp.stack(s_rows), axis)
+        d = lax.axis_index(axis)
+        acc = jnp.zeros((q_len,), jnp.float32)
+        for r in range(R):
+            sc_r = lax.dynamic_index_in_dim(
+                sc_all[r], d, 0, keepdims=False
+            )
+            sc_elem = jnp.repeat(
+                sc_r, jnp.asarray(lens), total_repeat_length=q_len
+            )
+            acc = _hardround(
+                acc + _dev_dequant_int8(qt[r], sc_elem, z), z
+            )
+        h = _hardround(acc / denom, z)
+        if ef:
+            w = jnp.concatenate(w_rows) if len(w_rows) > 1 else w_rows[0]
+            e = _hardround(gq - w, z)
+        return h, e
+
+    def local_update(z, h, p, opt_local):
+        # the PR 8 sharded update, on-device: this device owns the
+        # replica-indexed sub-shard of its model shard
+        import optax
+
+        r = lax.axis_index(axis)
+        p_sub = lax.dynamic_slice(p, (r * q_len,), (q_len,))
+        updates, new_opt = spec.tx.update(h, opt_local, p_sub)
+        new_sub = _hardround(optax.apply_updates(p_sub, updates), z)
+        return new_sub, new_opt
+
+    def local_gather(new_sub):
+        # params allgather over the replica axis: raw bytes, so every
+        # replica's model shard is bitwise identical by construction
+        return (
+            lax.all_gather(new_sub, axis).reshape(p_len)
+            if R > 1 else new_sub
+        )
+
+    return local_grad, local_exchange, local_update, local_gather
+
+
+def _fused_avals(mesh_mgr: MeshManager, spec: "_FusedSpec"):
+    """(rep_sharding, row_sharding, {name: aval}) for the program
+    family's operand layouts — device-stacked (D, ...) arrays
+    partitioned on dim 0 over every mesh axis."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh, axes = _fused_axes(mesh_mgr, spec)
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P(axes))
+    D = spec.replicas * spec.model_shards
+    avals = {
+        "z": jax.ShapeDtypeStruct((), np.int32, sharding=rep),
+        "p": jax.ShapeDtypeStruct(
+            (D, spec.p_len), np.float32, sharding=row
+        ),
+        "b": jax.ShapeDtypeStruct(
+            (D, spec.batch_size), np.float32, sharding=row
+        ),
+        "e": jax.ShapeDtypeStruct(
+            (D, spec.p_len), np.float32, sharding=row
+        ),
+        "h": jax.ShapeDtypeStruct(
+            (D, spec.q_len), np.float32, sharding=row
+        ),
+        "ns": jax.ShapeDtypeStruct(
+            (D, spec.q_len), np.float32, sharding=row
+        ),
+        "opt": [
+            jax.ShapeDtypeStruct(
+                (D,) + tuple(shape), np.dtype(dt), sharding=row
+            )
+            for shape, dt in zip(
+                spec.opt_leaf_shapes, spec.opt_leaf_dtypes
+            )
+        ],
+    }
+    return rep, row, avals
+
+
+def _build_fused_step(mesh_mgr: MeshManager, spec: "_FusedSpec"):
+    """Compile the ENTIRE training step into ONE executable over the
+    (replica, model) mesh: grad-apply → quantize → psum_scatter →
+    sharded optimizer update → params allgather, with zero host
+    round-trips between them. Signature:
+    ``fn(z, p, b, e, *opt) -> (new_p, loss, new_e, *new_opt)`` over
+    device-stacked operands. The donation contract holds at the step
+    surface exactly as for every staged collective: the caller's
+    buffers are replaced wholesale by the outputs (torchft_tpu/fused.py
+    copies back), never partially mutated mid-flight."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh, axes = _fused_axes(mesh_mgr, spec)
+    local_grad, local_exchange, local_update, local_gather = (
+        _fused_local_fns(mesh_mgr, spec)
+    )
+    treedef = spec.opt_treedef
+
+    def fn(z, p, b, e, *opt_leaves):
+        def local(z, p, b, e, *opt_leaves):
+            opt_local = jax.tree_util.tree_unflatten(
+                treedef, [leaf[0] for leaf in opt_leaves]
+            )
+            gm, loss = local_grad(z, p[0], b[0])
+            h, new_e = local_exchange(z, gm, e[0])
+            new_sub, new_opt = local_update(z, h, p[0], opt_local)
+            new_p = local_gather(new_sub)
+            outs = [new_p[None], loss.reshape(1), new_e[None]]
+            outs.extend(
+                jnp.expand_dims(leaf, 0)
+                for leaf in jax.tree_util.tree_leaves(new_opt)
+            )
+            return tuple(outs)
+
+        mesh_mgr._note_trace()
+        n = 3 + len(opt_leaves)
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(),) + (P(axes),) * n,
+            out_specs=(P(axes),) * n,
+            check_rep=False,
+        )(z, p, b, e, *opt_leaves)
+
+    rep, row, avals = _fused_avals(mesh_mgr, spec)
+    args = [avals["z"], avals["p"], avals["b"], avals["e"]] + avals["opt"]
+    with _x64_trace():
+        return jax.jit(fn).lower(*args).compile(), (rep, row)
+
+
+def _build_step_stage(mesh_mgr: MeshManager, spec: "_FusedSpec",
+                      stage: str):
+    """Compile ONE stage of the staged A/B arm — the same local bodies
+    the fused builder composes, as a standalone executable whose
+    inputs/outputs cross the host between dispatches. Stages:
+    ``grad``     ``fn(z, p, b) -> (gm, loss)``
+    ``exchange`` ``fn(z, gm, e) -> (h, new_e)``
+    ``update``   ``fn(z, h, p, *opt) -> (new_sub, *new_opt)``
+    ``gather``   ``fn(new_sub) -> (new_p,)``"""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh, axes = _fused_axes(mesh_mgr, spec)
+    local_grad, local_exchange, local_update, local_gather = (
+        _fused_local_fns(mesh_mgr, spec)
+    )
+    treedef = spec.opt_treedef
+    rep, row, avals = _fused_avals(mesh_mgr, spec)
+
+    if stage == "grad":
+        def fn(z, p, b):
+            def local(z, p, b):
+                gm, loss = local_grad(z, p[0], b[0])
+                return gm[None], loss.reshape(1)
+
+            mesh_mgr._note_trace()
+            return shard_map(
+                local, mesh=mesh,
+                in_specs=(P(), P(axes), P(axes)),
+                out_specs=(P(axes), P(axes)), check_rep=False,
+            )(z, p, b)
+
+        args = [avals["z"], avals["p"], avals["b"]]
+    elif stage == "exchange":
+        def fn(z, gm, e):
+            def local(z, gm, e):
+                h, new_e = local_exchange(z, gm[0], e[0])
+                return h[None], new_e[None]
+
+            mesh_mgr._note_trace()
+            return shard_map(
+                local, mesh=mesh,
+                in_specs=(P(), P(axes), P(axes)),
+                out_specs=(P(axes), P(axes)), check_rep=False,
+            )(z, gm, e)
+
+        args = [avals["z"], avals["p"], avals["e"]]
+    elif stage == "update":
+        def fn(z, h, p, *opt_leaves):
+            def local(z, h, p, *opt_leaves):
+                opt_local = jax.tree_util.tree_unflatten(
+                    treedef, [leaf[0] for leaf in opt_leaves]
+                )
+                new_sub, new_opt = local_update(
+                    z, h[0], p[0], opt_local
+                )
+                outs = [new_sub[None]]
+                outs.extend(
+                    jnp.expand_dims(leaf, 0)
+                    for leaf in jax.tree_util.tree_leaves(new_opt)
+                )
+                return tuple(outs)
+
+            mesh_mgr._note_trace()
+            n = 2 + len(opt_leaves)
+            return shard_map(
+                local, mesh=mesh,
+                in_specs=(P(),) + (P(axes),) * n,
+                out_specs=(P(axes),) * (1 + len(opt_leaves)),
+                check_rep=False,
+            )(z, h, p, *opt_leaves)
+
+        args = [avals["z"], avals["h"], avals["p"]] + avals["opt"]
+    elif stage == "gather":
+        def fn(new_sub):
+            def local(new_sub):
+                return (local_gather(new_sub[0])[None],)
+
+            mesh_mgr._note_trace()
+            return shard_map(
+                local, mesh=mesh, in_specs=(P(axes),),
+                out_specs=(P(axes),), check_rep=False,
+            )(new_sub)
+
+        args = [avals["ns"]]
+    else:
+        raise ValueError(f"unknown step stage {stage!r}")
+
+    with _x64_trace():
+        return jax.jit(fn).lower(*args).compile(), (rep, row)
 
 
 # ------------------------------------------------- hierarchical builders
@@ -1880,7 +2296,8 @@ class XlaCommContext(CommContext):
                  chunk_bytes: int = 1 << 20,
                  mesh_manager: Optional[MeshManager] = None,
                  topology: str = "flat",
-                 domain_resolver=None) -> None:
+                 domain_resolver=None,
+                 model_shards: int = 1) -> None:
         super().__init__()
         if isinstance(timeout, timedelta):
             timeout = timeout.total_seconds()
@@ -1904,6 +2321,12 @@ class XlaCommContext(CommContext):
         # can serve per-op hier ops (the bench's A/B lever).
         self._topology_default = topology
         self._domain_resolver = domain_resolver
+        # 2-D mesh declaration: the model-axis extent of each replica
+        # group on the fused-step plane (fused.py). The WIRE collectives
+        # this context serves stay 1-D (axis-scoped to "replica"), so
+        # this is introspection — mesh_shape() — plus plumbing for the
+        # fused builders, never a change to the exchange sequence.
+        self._model_shards = max(1, int(model_shards))
         self._wire_members: "Optional[List[str]]" = None
         self._configured_members: "Optional[List[str]]" = None
         self._hier_assignment = None
@@ -1967,6 +2390,11 @@ class XlaCommContext(CommContext):
                 "every op)"
             )
         return None
+
+    def mesh_shape(self) -> Tuple[int, int]:
+        """(replicas, model_shards): the wire world times the declared
+        model-axis extent (CommContext introspection override)."""
+        return (self.world_size(), self._model_shards)
 
     def set_metrics(self, metrics: Metrics) -> None:
         """Share the Manager's sink (same contract as TcpCommContext);

@@ -803,12 +803,18 @@ class _ShardPlan(_BucketPlan):
                 return shard
         raise IndexError(f"leaf {leaf} outside the shard grid")
 
-    def shard_spec(self):
+    def shard_spec(self, model_shards: int = 1):
         """This grid as a redistribution destination spec — what the
         reshard exchange compiles (src holdings → this) transfer plans
-        against (comm/redistribute.py)."""
+        against (comm/redistribute.py). ``model_shards > 1`` prices the
+        2-D (replica × model) layout: each leaf becomes ``model_shards``
+        sub-units so a mesh-shape change is planned exactly."""
         from torchft_tpu.comm.redistribute import ShardSpec
 
+        if model_shards > 1:
+            return ShardSpec.from_ranges_2d(
+                self.ranges, model_shards, len(self.sizes)
+            )
         return ShardSpec.from_ranges(self.ranges, len(self.sizes))
 
     def owned_leaves(self, rank: int) -> "List[int]":

@@ -153,6 +153,7 @@ class Manager:
         data_plane: bool = True,
         comm_backend: Optional[str] = None,
         comm_options: Optional[Dict[str, Any]] = None,
+        model_shards: int = 1,
     ) -> None:
         # min_replica_size stays effectively REQUIRED even though comm's
         # new default forced a syntactic default onto everything after
@@ -355,6 +356,17 @@ class Manager:
         # distinguishable by inspection (contexts with set_metrics
         # re-assert it; this covers identity/test contexts too).
         self.metrics.label("comm_backend", self.comm_backend())
+        # 2-D (replica × model) mesh declaration: how many devices one
+        # replica group spans on the fused-step plane (fused.py). The
+        # WIRE stays 1-D over replicas; this rides telemetry as the
+        # mesh_shape label ("replicas x model_shards", re-asserted at
+        # every quorum) and sizes the sharded optimizer's sub-unit grid
+        # (optim.py model_shards="auto").
+        self.model_shards = max(1, int(model_shards))
+        self.metrics.label(
+            "mesh_shape",
+            f"{self._transport_world_size}x{self.model_shards}",
+        )
         # Share our metrics sink with the transport so its per-lane phase
         # timers (comm_submit_wire / comm_wire_reduce / comm_reduce_future)
         # land next to quorum/commit_barrier/allreduce in one snapshot.
@@ -882,6 +894,13 @@ class Manager:
             t_rank, t_world = quorum.replica_rank, quorum.replica_world_size
             fingerprint = "all"
         self._transport_world_size = t_world if in_transport else 1
+        # mesh_shape follows the wire world: a shrink/grow re-labels the
+        # sink so fleet_top (and evidence JSONs) always show the CURRENT
+        # replicas x model_shards layout.
+        self.metrics.label(
+            "mesh_shape",
+            f"{self._transport_world_size}x{self.model_shards}",
+        )
         # Flight recorder: a replica that was on the wire last quorum
         # and is absent now left the fleet (death, kill, or departure) —
         # the member_dead events plus the epoch stamps are what let a

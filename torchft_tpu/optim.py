@@ -221,7 +221,8 @@ class ShardedOptimizerWrapper:
     def __init__(self, manager, tx, state_fn=None, sharded: bool = True,
                  error_feedback: "bool | str" = "auto",
                  redistribute: str = "plan",
-                 planner=None) -> None:
+                 planner=None,
+                 model_shards: "int | str" = "auto") -> None:
         import jax
         import optax
 
@@ -241,6 +242,13 @@ class ShardedOptimizerWrapper:
         self._state_fn = state_fn
         self._sharded = bool(sharded)
         self._redistribute = redistribute
+        # 2-D (replica × model) layout: each leaf state is priced as
+        # model_shards sub-units so the planner bounds a reshard at a
+        # changed world size or mesh shape EXACTLY ("auto" follows the
+        # Manager's mesh). Must match across replicas, like `sharded`.
+        if model_shards == "auto":
+            model_shards = getattr(manager, "model_shards", 1)
+        self._model_shards = max(1, int(model_shards))
         # Plan cache (hit/miss-counted): per-wrapper unless a shared
         # planner is injected (bench/smoke harnesses pin cache behavior
         # across arms/transitions through one instance).
@@ -382,19 +390,38 @@ class ShardedOptimizerWrapper:
         if world > 1 and self._redistribute == "plan":
             import jax
 
-            from torchft_tpu.checkpointing import redistribute_exchange
+            from torchft_tpu.checkpointing import (
+                join_leaf_payload,
+                redistribute_exchange,
+                split_leaf_payload,
+            )
 
-            # Holdings stay DEVICE arrays: the exchange reads only
-            # nbytes metadata from them, and the serve side stages
-            # lazily — a leaf pays its device-to-host copy exactly when
-            # a receiver actually fetches it (the legacy arm's
-            # outgoing-only materialization, generalized).
-            holdings = {
-                i: jax.tree_util.tree_leaves(opt_state.leaf_states[i])
-                for i in sorted(held)
-            }
+            M = self._model_shards
+            if M > 1:
+                # 2-D mesh: each leaf state splits into M contiguous
+                # sub-unit payloads (unit = leaf * M + shard) so the
+                # planner prices a mesh-shape change exactly. Sub-unit
+                # payloads are host slices (views), staged per fetch
+                # like the 1-D arm.
+                holdings = {
+                    i * M + m: pieces
+                    for i in sorted(held)
+                    for m, pieces in enumerate(split_leaf_payload(
+                        self._flatten_state(opt_state.leaf_states[i]), M
+                    ))
+                }
+            else:
+                # Holdings stay DEVICE arrays: the exchange reads only
+                # nbytes metadata from them, and the serve side stages
+                # lazily — a leaf pays its device-to-host copy exactly
+                # when a receiver actually fetches it (the legacy arm's
+                # outgoing-only materialization, generalized).
+                holdings = {
+                    i: jax.tree_util.tree_leaves(opt_state.leaf_states[i])
+                    for i in sorted(held)
+                }
             result = redistribute_exchange(
-                mgr, my_rank, world, plan.shard_spec(), holdings,
+                mgr, my_rank, world, plan.shard_spec(M), holdings,
                 self._planner, source="reshard",
             )
             if result is None:
@@ -402,9 +429,31 @@ class ShardedOptimizerWrapper:
                 # grid — this step discards, and the next healthy
                 # quorum's generation bump retries the exchange.
                 return opt_state
-            available = result.fetched
             wire_bytes = result.moved_bytes
             lower_bound = result.lower_bound_bytes
+            if M > 1:
+                # Reassemble each needed leaf from its M sub-units;
+                # any gap (or byte mismatch) demotes the leaf to the
+                # reinit path — the standard adoption contract.
+                for i in sorted(owned - held):
+                    subs = [result.fetched.get(i * M + m)
+                            for m in range(M)]
+                    if any(s is None for s in subs):
+                        continue
+                    shapes = [
+                        a.shape for a in self._flatten_state(
+                            self._leaf_init(param_leaves[i])
+                        )
+                    ]
+                    try:
+                        available[i] = join_leaf_payload(subs, shapes)
+                    except ValueError:
+                        logger.warning(
+                            "reshard: leaf %d sub-units did not "
+                            "reassemble; reinitializing", i,
+                        )
+            else:
+                available = result.fetched
         elif world > 1:
             # Legacy allgather exchange (the A/B arm): contribution is
             # [outgoing indices (i64)] + each outgoing leaf's flattened
@@ -496,6 +545,7 @@ class ShardedOptimizerWrapper:
                 kept_leaves=kept,
                 reinit_leaves=len(reinit),
                 owned_leaves=len(owned),
+                mesh_shape=f"{world}x{self._model_shards}",
             )
         return out
 

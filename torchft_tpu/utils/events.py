@@ -46,6 +46,11 @@ Event vocabulary (producers in parentheses):
                                       fingerprints, cache hit/miss,
                                       fetch/unsourced counts, moved vs
                                       lower-bound bytes)
+    fused_step                       (fused.py: one fused
+                                      single-executable step dispatched —
+                                      mesh shape, codec, dispatch /
+                                      executable counts, compile-cache
+                                      state)
 
 Every event is stamped with a process-monotonic sequence number, wall +
 monotonic clocks, the bound replica_id/rank, and (when the emitter knows
@@ -101,6 +106,7 @@ EVENT_KINDS = (
     "shard_grid_rebuild",
     "reshard",
     "redist_plan",
+    "fused_step",
 )
 
 _DEFAULT_CAPACITY = 4096
