@@ -166,6 +166,120 @@ def _sharded_update_phase() -> dict:
     return out
 
 
+def _grow_chaos_phase() -> dict:
+    """Elastic-GROWTH chaos arm (ROADMAP item 5 slice): the chaos
+    machinery above only ever SHRINKS the fleet (SIGKILL). This phase
+    is the other direction — a group JOINS mid-run: a 2-rank sharded
+    run's states are carried into a 3-rank continuation, where the
+    joiner's shard arrives through the redistribution planner. The
+    oracles are counters, not wall clock: the ``reshard`` events must
+    show reinit_leaves == 0 (on a grow every leaf has a live holder —
+    nothing may be cold-initialized) and every rank must pin
+    ``redist_moved_bytes == redist_lower_bound_bytes``. In-process
+    threads over a real TCP loopback transport (the sharded-phase
+    harness shape); guarded: a failure yields an ``error`` field,
+    never a lost artifact. BENCH_GROW=0 skips it."""
+    import copy
+
+    import numpy as np
+    import optax
+
+    import jax
+    import jax.numpy as jnp
+
+    from torchft_tpu.comm.store import StoreServer
+    from torchft_tpu.comm.transport import TcpCommContext
+    from torchft_tpu.comm.wire_stub import run_stub_ranks
+    from torchft_tpu.optim import ShardedOptimizerWrapper
+
+    src_world = int(os.environ.get("BENCH_GROW_SRC_WORLD", "2"))
+    dst_world = src_world + 1
+    n_leaves = int(os.environ.get("BENCH_GROW_LEAVES", "8"))
+    leaf_elems = int(os.environ.get("BENCH_GROW_ELEMS", "2048"))
+    rng = np.random.default_rng(23)
+    params0 = {
+        f"w{i:02d}": rng.standard_normal(leaf_elems + i).astype(np.float32)
+        for i in range(n_leaves)
+    }
+    store = StoreServer()
+    out: dict = {"src_world": src_world, "dst_world": dst_world}
+    try:
+        def seed_fn(mgr, rank: int):
+            opt = ShardedOptimizerWrapper(
+                mgr, optax.adamw(1e-3), sharded=True
+            )
+            params = jax.tree_util.tree_map(jnp.asarray, params0)
+            state = opt.init(params)
+            for s in range(2):
+                mgr.start_quorum()
+                grads = jax.tree_util.tree_map(
+                    lambda x: x * np.float32(0.01 * (rank + 1) * (s + 1)),
+                    params,
+                )
+                params, state, ok = opt.step(params, state, grads)
+                if not ok:
+                    raise RuntimeError("grow seed step discarded")
+            return state
+
+        _touch("grow_seed")
+        carried = run_stub_ranks(
+            store.addr, "grow_seed", src_world, seed_fn,
+            lambda: TcpCommContext(timeout=20.0),
+        ) + [None]  # the joiner arrives stateless
+
+        def grow_fn(mgr, rank: int) -> dict:
+            opt = ShardedOptimizerWrapper(
+                mgr, optax.adamw(1e-3), sharded=True,
+                redistribute="plan",
+            )
+            params = jax.tree_util.tree_map(jnp.asarray, params0)
+            state = (
+                copy.deepcopy(carried[rank])
+                if carried[rank] is not None else opt.init(params)
+            )
+            mgr.start_quorum()
+            grads = jax.tree_util.tree_map(
+                lambda x: x * np.float32(0.02 * (rank + 1)), params
+            )
+            params, state, ok = opt.step(params, state, grads)
+            if not ok:
+                raise RuntimeError("grow step discarded")
+            snap = mgr.metrics.snapshot()
+            ev, _, _ = mgr.events.since(0)
+            resh = [e for e in ev if e["kind"] == "reshard"]
+            return {
+                "moved": float(snap.get("redist_moved_bytes") or 0.0),
+                "lower": float(
+                    snap.get("redist_lower_bound_bytes") or 0.0
+                ),
+                "reinit": sum(
+                    e.get("reinit_leaves") or 0 for e in resh
+                ),
+                "reshard_events": len(resh),
+            }
+
+        _touch("grow_phase")
+        ranks = run_stub_ranks(
+            store.addr, "grow_arm", dst_world, grow_fn,
+            lambda: TcpCommContext(timeout=20.0),
+        )
+        out.update(
+            moved_bytes=sum(r["moved"] for r in ranks),
+            lower_bound_bytes=sum(r["lower"] for r in ranks),
+            reinit_leaves=sum(r["reinit"] for r in ranks),
+            reshard_events=sum(r["reshard_events"] for r in ranks),
+            minimal=all(r["moved"] == r["lower"] for r in ranks),
+            # THE grow oracle: a join must never cold-init a leaf that
+            # has a live holder
+            reinit_zero=all(r["reinit"] == 0 for r in ranks),
+        )
+    except Exception as e:  # noqa: BLE001 — never lose the artifact
+        out["error"] = repr(e)
+    finally:
+        store.shutdown()
+    return out
+
+
 def _sync_algorithms_phase() -> dict:
     """Measured LocalSGD + DiLoCo segments (BASELINE.json configs 3-4).
 
@@ -2007,6 +2121,14 @@ def _run() -> None:
     )
     _PARTIAL["sharded"] = sharded_phase
 
+    # Elastic-growth chaos arm (ROADMAP item 5): a group JOINS mid-run;
+    # the reshard reinit==0 + minimal-bytes oracles gate it.
+    grow_phase = (
+        _grow_chaos_phase()
+        if os.environ.get("BENCH_GROW", "1") != "0" else None
+    )
+    _PARTIAL["grow"] = grow_phase
+
     flops_step = _flops_per_step(cfg, n_params, seq_len, tokens_per_step)
     if peak_flops is not None:
         mfu = flops_step * steps / t1_elapsed / peak_flops
@@ -2051,6 +2173,7 @@ def _run() -> None:
                 (sharded_phase or {}).get("t1_opt_state_bytes")
             ),
             "sharded": sharded_phase,
+            "grow": grow_phase,
             "t1_phase_ms": t1_phase_ms,
             "t1_min_replica_world": t1_min_world,
             "t1_participants_min": min(t1_parts),

@@ -937,6 +937,52 @@ def fleet_smoke() -> "list[str]":
     return failures
 
 
+def pipeline_smoke() -> "list[str]":
+    """One in-process 2-stage x 4-microbatch pipeline round per
+    schedule arm; returns failure strings if any ``pipe_*`` gauge is
+    missing/non-finite or the pipelined step is not bitwise-identical
+    to the stage-serial one (the MPMD plane's correctness oracle)."""
+    import math
+
+    import torchft_tpu.pipeline as P
+
+    failures: "list[str]" = []
+    hashes = {}
+    snaps = {}
+    for arm, streaming in (("1f1b", True), ("serial", False)):
+        pipe = P.Pipeline(P.PipelineConfig(
+            num_stages=2, replicas=1, microbatches=4,
+            step_timeout=60.0, streaming=streaming,
+        ))
+        try:
+            r = pipe.run_step()
+            if r["aborted"] or r["killed"]:
+                failures.append(f"pipeline smoke: {arm} step failed: {r}")
+            hashes[arm] = pipe.global_param_hash()
+            snaps[arm] = pipe.metrics_snapshots()
+        finally:
+            pipe.close()
+    if failures:
+        return failures
+    if hashes["1f1b"] != hashes["serial"]:
+        failures.append(
+            "pipeline smoke: pipelined step not bitwise with the "
+            "stage-serial arm"
+        )
+    for rid, snap in snaps["1f1b"].items():
+        for key in ("pipe_inflight", "pipe_stage_index",
+                    "pipe_stage_count", "pipe_bubble_steps",
+                    "pipe_sched_ticks", "microbatch_send",
+                    "microbatch_recv"):
+            v = snap.get(key)
+            if v is None or not math.isfinite(float(v)) or float(v) < 0:
+                failures.append(
+                    f"pipeline smoke: {rid} gauge {key!r} "
+                    f"missing/non-finite: {v!r}"
+                )
+    return failures
+
+
 def main() -> int:
     env = {
         k: v for k, v in os.environ.items()
@@ -987,6 +1033,7 @@ def main() -> int:
     failures += redist_smoke()
     failures += fused_smoke()
     failures += fleet_smoke()
+    failures += pipeline_smoke()
     for key in ("t1_pipeline_overlap", "t1_pipeline_ms", "t1_ddp_streamed",
                 "t1_overhead_ms", "t1_outer_overlap", "t1_outer_wire_ms",
                 "comm_backend", "t1_events_recorded",
@@ -1044,7 +1091,8 @@ def main() -> int:
         f"opt_state_ratio={(payload.get('sharded') or {}).get('state_bytes_ratio')} "
         "heal_gauges=ok outer_gauges=ok xla_gauges=ok qpsum_gauges=ok "
         "hier_gauges=ok chrome_trace=ok sharded_gauges=ok "
-        "redist_gauges=ok fused_gauges=ok fleet_gauges=ok"
+        "redist_gauges=ok fused_gauges=ok fleet_gauges=ok "
+        "pipe_gauges=ok"
     )
     return 0
 

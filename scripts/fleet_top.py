@@ -178,6 +178,9 @@ def build_row(ep: Dict[str, Any],
         "heal_mb_s": None,
         "ddp_overlap": None,
         "outer_overlap": None,
+        "stage": None,
+        "inflight": None,
+        "bubble": None,
         "d_intra_mb": None,
         "d_inter_mb": None,
         "redist_waste_mb": None,
@@ -214,6 +217,22 @@ def build_row(ep: Dict[str, Any],
     if wt and we is not None:
         row["ddp_overlap"] = max(0.0, min(1.0, 1.0 - we / wt))
     row["outer_overlap"] = m.get("outer_overlap")
+    # Pipeline topology (ISSUE 17): which stage of how many this
+    # replica group serves, its peak in-flight microbatch count, and
+    # the realized bubble fraction (idle schedule slots / total ticks)
+    # — the MPMD plane's whole health story in three numbers.
+    sc = m.get("pipe_stage_count")
+    if sc is not None and float(sc) > 1:
+        row["stage"] = (
+            f"{int(float(m.get('pipe_stage_index') or 0))}"
+            f"/{int(float(sc))}"
+        )
+    inflight = m.get("pipe_inflight")
+    if inflight is not None:
+        row["inflight"] = int(float(inflight))
+    bub, ticks = m.get("pipe_bubble_steps"), m.get("pipe_sched_ticks")
+    if bub is not None and ticks:
+        row["bubble"] = max(0.0, min(1.0, float(bub) / float(ticks)))
     # Redistribution waste: cumulative bytes reshard/heal exchanges
     # received BEYOND the set-theoretic minimum — 0 on planned
     # transfers, the legacy allgather arm's avoidable broadcast
@@ -251,6 +270,7 @@ _COLUMNS = (
     ("mesh", 5), ("mode", 6),
     ("committed", 9), ("discarded", 9), ("allreduce_p50_ms", 16),
     ("heal_mb_s", 9), ("ddp_overlap", 11), ("outer_overlap", 13),
+    ("stage", 5), ("inflight", 8), ("bubble", 6),
     ("d_intra_mb", 10), ("d_inter_mb", 10), ("redist_waste_mb", 15),
     ("last_event", 34),
 )
@@ -313,7 +333,7 @@ def render(status: Dict[str, Any], rows: List[Dict[str, Any]]) -> str:
         cells = []
         for name, w in _COLUMNS:
             v = row.get(name)
-            nd = 2 if "overlap" in name else 1
+            nd = 2 if ("overlap" in name or name == "bubble") else 1
             cells.append(_fmt(v, nd).ljust(w))
         out.append(" ".join(cells))
     dead = [

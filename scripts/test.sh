@@ -25,9 +25,13 @@
 #                                  # present, AND that the redist gauges are
 #                                  # finite with moved == lower-bound bytes
 #                                  # and a plan-cache hit on the second
-#                                  # identical transition (metric/event
-#                                  # regressions fail loudly instead of
-#                                  # vanishing)
+#                                  # identical transition, AND one
+#                                  # in-process 2-stage x 4-microbatch
+#                                  # pipeline round per schedule arm with
+#                                  # finite pipe_* gauges and a bitwise
+#                                  # pipelined-vs-stage-serial step
+#                                  # (metric/event regressions fail
+#                                  # loudly instead of vanishing)
 
 set -u
 cd "$(dirname "$0")/.."

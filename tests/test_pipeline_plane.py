@@ -1,0 +1,462 @@
+"""MPMD pipeline plane (ISSUE 17): streaming 1F1B microbatch stages
+with drain-free stage heal.
+
+Covers the tentpole's contracts end to end:
+
+- schedule projection + bubble math (pure functions);
+- the bitwise oracle: pipelined 1F1B ≡ stage-serial GPipe
+  sha256-for-sha256 per optimizer step, for every stage-wire codec
+  {none, bf16, int8+EF};
+- 1F1B's bounded in-flight count (S) vs GPipe's (M);
+- stage-replica kill healed WITHOUT draining (pipe_drained_steps == 0,
+  replay wave counted, heal moved bytes == the PR 14 lower bound) vs
+  the drain-and-restart baseline (>=1 discarded step per live replica,
+  full-tree bytes);
+- elastic stage re-balancing: planner-minimal moved bytes and a
+  bit-identical training trajectory;
+- the flight-recorder contract at pipeline granularity: the full
+  kill → heal → resume lifecycle AND the executed schedule
+  reconstructed from the ``/telemetry/events`` HTTP endpoints alone;
+- Manager/WireStubManager stage-accessor surface parity.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import torchft_tpu.pipeline as P
+from torchft_tpu.pipeline import (
+    Pipeline,
+    PipelineConfig,
+    expected_stage_sequence,
+    reconstruct_pipe_schedule,
+    stage_bubble_slots,
+)
+
+
+def _fetch(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def _snap_sum(pipe: Pipeline, name: str) -> float:
+    return sum(
+        s.get(name, 0.0) for s in pipe.metrics_snapshots().values()
+    )
+
+
+# ----------------------------------------------------------- pure schedule
+
+
+def test_expected_stage_sequence_projects_the_global_schedule():
+    # S=2, M=4, 1F1B: stage 0 warms up S=2 forwards, then alternates
+    assert expected_stage_sequence(2, 4, 0) == [
+        ("F", 0), ("F", 1), ("B", 0), ("F", 2),
+        ("B", 1), ("F", 3), ("B", 2), ("B", 3),
+    ]
+    # the last stage strictly alternates
+    assert expected_stage_sequence(2, 4, 1) == [
+        ("F", 0), ("B", 0), ("F", 1), ("B", 1),
+        ("F", 2), ("B", 2), ("F", 3), ("B", 3),
+    ]
+    # GPipe: all forwards, then all backwards
+    seq = expected_stage_sequence(2, 4, 0, streaming=False)
+    phases = [p for p, _ in seq]
+    assert phases == ["F"] * 4 + ["B"] * 4
+    # every microbatch appears exactly once per phase on every stage
+    for streaming in (True, False):
+        for stage in range(3):
+            seq = expected_stage_sequence(3, 5, stage,
+                                          streaming=streaming)
+            assert sorted(m for p, m in seq if p == "F") == list(range(5))
+            assert sorted(m for p, m in seq if p == "B") == list(range(5))
+
+
+def test_stage_bubble_slots_match_the_analytic_count():
+    for streaming in (True, False):
+        for s_count, m in ((2, 4), (3, 6), (4, 4)):
+            idle, ticks = stage_bubble_slots(s_count, m,
+                                             streaming=streaming)
+            # 1F1B and GPipe share makespan and bubble at equal M
+            assert ticks == 2 * (s_count - 1) + 2 * m
+            assert idle == 2 * (s_count - 1)
+
+
+# --------------------------------------------------------- bitwise oracle
+
+
+def test_pipelined_bitwise_identical_to_stage_serial_none_codec():
+    hashes = {}
+    for streaming in (True, False):
+        pipe = Pipeline(PipelineConfig(
+            num_stages=2, replicas=1, microbatches=4,
+            streaming=streaming, step_timeout=60.0,
+        ))
+        try:
+            traj = []
+            for _ in range(3):
+                r = pipe.run_step()
+                assert not r["aborted"] and not r["killed"]
+                traj.append(pipe.global_param_hash())
+            hashes[streaming] = traj
+            peak = r["inflight_peak"]
+        finally:
+            pipe.close()
+        # 1F1B bounds in-flight at S; GPipe fills to M
+        assert peak == (2 if streaming else 4)
+    assert hashes[True] == hashes[False]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("codec,ef", [("bf16", False), ("int8", True)])
+def test_pipelined_bitwise_identical_lossy_stage_wire(codec, ef):
+    """The bitwise oracle survives lossy stage wires: both arms push
+    their frames through the SAME codec (+ EF residuals on the grad
+    hop), so the trajectories stay bit-identical — to each other, not
+    to the uncompressed run."""
+    hashes = {}
+    for streaming in (True, False):
+        pipe = Pipeline(PipelineConfig(
+            num_stages=3, replicas=1, microbatches=4,
+            layer_dims=(8,) * 7, codec=codec, error_feedback=ef,
+            streaming=streaming, step_timeout=60.0,
+        ))
+        try:
+            traj = []
+            for _ in range(3):
+                pipe.run_step()
+                traj.append(pipe.global_param_hash())
+            hashes[streaming] = traj
+        finally:
+            pipe.close()
+    assert hashes[True] == hashes[False]
+
+
+def test_multi_replica_lanes_commit_one_stage_hash():
+    """M=4 striped over R=2 lanes: both replicas of a stage must land
+    the identical post-step params (the deterministic lane
+    rendezvous), and the run must match the single-replica trajectory
+    is NOT required — lane summation order differs — but determinism
+    across reruns is."""
+    trajs = []
+    for _ in range(2):
+        pipe = Pipeline(PipelineConfig(
+            num_stages=2, replicas=2, microbatches=4,
+            step_timeout=60.0,
+        ))
+        try:
+            traj = []
+            for _ in range(2):
+                r = pipe.run_step()
+                assert not r["aborted"] and not r["killed"]
+                for stage in range(2):
+                    stage_hashes = {
+                        h for (s, _), h in r["hashes"].items()
+                        if s == stage
+                    }
+                    assert len(stage_hashes) == 1
+                traj.append(pipe.global_param_hash())
+            trajs.append(traj)
+        finally:
+            pipe.close()
+    assert trajs[0] == trajs[1]
+
+
+# ------------------------------------------------------------ kill arms
+
+
+def test_stage_kill_heals_without_draining():
+    pipe = Pipeline(PipelineConfig(
+        num_stages=2, replicas=2, microbatches=4,
+        on_kill="heal", step_timeout=60.0,
+    ))
+    try:
+        pipe.run_step()
+        pipe.schedule_kill(1, 1, after_actions=2)
+        r = pipe.run_step()
+        # the step COMMITS despite the mid-step death
+        assert r["killed"] == [(1, 1)]
+        assert not r["aborted"]
+        assert _snap_sum(pipe, "pipe_drained_steps") == 0
+        # the survivor replayed cached frames against adopted lanes
+        assert _snap_sum(pipe, "pipe_replay_microbatches") > 0
+        # heal the dead replica from its stage peer: planner-minimal
+        info = pipe.heal(1, 1)
+        assert info["moved_bytes"] == info["lower_bound_bytes"]
+        assert info["moved_bytes"] == pipe.stage_param_bytes(1)
+        assert info["moved_bytes"] < pipe.total_param_bytes()
+        # resume: the healed replica participates and agrees bitwise
+        r2 = pipe.run_step()
+        assert not r2["aborted"] and not r2["killed"]
+        stage1 = {h for (s, _), h in r2["hashes"].items() if s == 1}
+        assert len(stage1) == 1
+        assert _snap_sum(pipe, "pipe_drained_steps") == 0
+    finally:
+        pipe.close()
+
+
+@pytest.mark.slow
+def test_stage_kill_drain_baseline_pays_full_tree():
+    pipe = Pipeline(PipelineConfig(
+        num_stages=2, replicas=2, microbatches=4,
+        on_kill="drain", step_timeout=60.0,
+    ))
+    try:
+        pipe.run_step()
+        pipe.schedule_kill(1, 1, after_actions=2)
+        r = pipe.run_step()
+        assert r["killed"] == [(1, 1)]
+        assert not r["aborted"]  # the rerun eventually commits
+        # every live replica discarded the drained attempt
+        assert _snap_sum(pipe, "pipe_drained_steps") >= 3
+        # the drain heal refetched the FULL tree, not the stage slice
+        moved = _snap_sum(pipe, "redist_moved_bytes")
+        assert moved == pipe.total_param_bytes()
+        assert moved > pipe.stage_param_bytes(1)
+    finally:
+        pipe.close()
+
+
+# ------------------------------------------------------------- rebalance
+
+
+def test_rebalance_is_minimal_and_bitwise_transparent():
+    cfg = PipelineConfig(
+        num_stages=2, replicas=1, microbatches=4,
+        layer_dims=(8,) * 5, step_timeout=60.0,
+    )
+    control = Pipeline(cfg)
+    moved = Pipeline(cfg)
+    try:
+        control.run_step()
+        moved.run_step()
+        before = moved.global_param_hash()
+        info = moved.rebalance([[0, 1, 2], [3]])
+        # exactly one 8x8 layer (W + b) crossed stages, planner-minimal
+        assert info["moved_bytes"] == info["lower_bound_bytes"] > 0
+        assert moved.stage_layers == [[0, 1, 2], [3]]
+        # the move itself is bitwise-invisible
+        assert moved.global_param_hash() == before
+        # and so is the rest of the trajectory
+        for _ in range(2):
+            control.run_step()
+            moved.run_step()
+            assert moved.global_param_hash() \
+                == control.global_param_hash()
+    finally:
+        control.close()
+        moved.close()
+
+
+def test_rebalance_plan_cache_hits_on_reversal():
+    pipe = Pipeline(PipelineConfig(
+        num_stages=2, replicas=1, microbatches=4,
+        layer_dims=(8,) * 5, step_timeout=60.0,
+    ))
+    try:
+        a = pipe.rebalance([[0, 1, 2], [3]])
+        assert a["cache_hit"] is False
+        pipe.rebalance([[0, 1], [2, 3]])
+        # oscillating back to a seen spec pair must not recompile
+        b = pipe.rebalance([[0, 1, 2], [3]])
+        assert b["cache_hit"] is True
+        assert b["moved_bytes"] == a["moved_bytes"]
+    finally:
+        pipe.close()
+
+
+# ------------------------------------- flight recorder over real HTTP
+
+
+def test_schedule_reconstructed_from_telemetry_http_alone():
+    """PR 7/12 contract at pipeline granularity: the executed 1F1B
+    schedule rebuilt from the /telemetry/events HTTP endpoints alone
+    matches the scheduler's ground truth, per stage per step."""
+    from torchft_tpu.checkpointing import CheckpointServer
+
+    pipe = Pipeline(PipelineConfig(
+        num_stages=2, replicas=1, microbatches=4, step_timeout=60.0,
+    ))
+    servers = []
+    try:
+        for (stage, replica), rep in sorted(pipe.replicas.items()):
+            srv = CheckpointServer(timeout=10.0)
+            srv.set_metrics(rep.metrics)
+            srv.set_events(rep.events)
+            servers.append(srv)
+        pipe.run_step()
+        pipe.run_step()
+        dumps = [
+            _fetch(srv.metadata() + "/telemetry/events?since=0")
+            for srv in servers
+        ]
+        rec = reconstruct_pipe_schedule(dumps)
+        assert sorted(rec) == [0, 1]
+        for step in (0, 1):
+            for stage in range(2):
+                assert rec[step][stage] == expected_stage_sequence(
+                    2, 4, stage
+                )
+        # the metrics endpoints carry the pipe gauge surface too
+        for srv in servers:
+            m = _fetch(srv.metadata() + "/telemetry/metrics")["metrics"]
+            for key in ("pipe_inflight", "pipe_stage_index",
+                        "pipe_stage_count", "pipe_bubble_steps",
+                        "pipe_sched_ticks"):
+                assert np.isfinite(float(m[key]))
+    finally:
+        for srv in servers:
+            srv.shutdown()
+        pipe.close()
+
+
+@pytest.mark.slow
+def test_stage_kill_lifecycle_reconstructed_from_telemetry_http():
+    """The full kill → heal → resume lifecycle of a 2-stage pipeline,
+    reconstructed from /telemetry/events endpoints alone:
+
+        step_commit @0 → member_dead (s1r1) → replayed sends →
+        step_commit @1 with ZERO step_discard → heal_start/heal_done
+        at the stage-bytes lower bound → step_commit @2 from all four
+        replicas
+    """
+    from torchft_tpu.checkpointing import CheckpointServer
+
+    pipe = Pipeline(PipelineConfig(
+        num_stages=2, replicas=2, microbatches=4,
+        on_kill="heal", step_timeout=60.0,
+    ))
+    servers = {}
+
+    def _wire(key):
+        rep = pipe.replicas[key]
+        srv = CheckpointServer(timeout=10.0)
+        srv.set_metrics(rep.metrics)
+        srv.set_events(rep.events)
+        return srv
+
+    try:
+        for key in sorted(pipe.replicas):
+            servers[key] = _wire(key)
+        pipe.run_step()
+        pipe.schedule_kill(1, 1, after_actions=2)
+        r = pipe.run_step()
+        assert r["killed"] == [(1, 1)] and not r["aborted"]
+        info = pipe.heal(1, 1)
+        # the healed replica is a new process: new endpoint, old one
+        # keeps serving the pre-kill recorder (fleet_top's view)
+        servers[("healed", 1, 1)] = _wire((1, 1))
+        r2 = pipe.run_step()
+        assert not r2["aborted"] and not r2["killed"]
+
+        dumps = [
+            _fetch(srv.metadata() + "/telemetry/events?since=0")
+            for srv in servers.values()
+        ]
+        evs = [e for d in dumps for e in d["events"]]
+        kinds = [e["kind"] for e in evs]
+
+        # 1) the death is on the record
+        dead = [e for e in evs if e["kind"] == "member_dead"]
+        assert any(
+            e.get("stage") == 1 and e.get("replica") == 1 for e in dead
+        )
+        # 2) the kill step COMMITTED everywhere — drain-free means no
+        #    step_discard anywhere in the lifecycle
+        assert "step_discard" not in kinds
+        commits_by_step = {}
+        for e in evs:
+            if e["kind"] == "step_commit":
+                commits_by_step.setdefault(e["step"], 0)
+                commits_by_step[e["step"]] += 1
+        assert commits_by_step[1] == 3   # the three survivors
+        assert commits_by_step[2] == 4   # full strength after heal
+        # 3) the replay wave is visible on the send record
+        replays = [
+            e for e in evs
+            if e["kind"] == "microbatch_send" and e.get("replay")
+        ]
+        assert replays
+        # 4) heal pinned at the planner lower bound, from events alone
+        done = [e for e in evs if e["kind"] == "heal_done"]
+        assert len(done) == 1
+        assert done[0]["moved_bytes"] == done[0]["lower_bound_bytes"]
+        assert done[0]["moved_bytes"] == info["moved_bytes"]
+        assert done[0]["full_tree"] is False
+    finally:
+        for srv in servers.values():
+            srv.shutdown()
+        pipe.close()
+
+
+# ------------------------------------------------- manager surface parity
+
+
+def test_manager_and_stub_share_the_stage_surface():
+    from torchft_tpu.comm.context import DummyCommContext
+    from torchft_tpu.comm.wire_stub import WireStubManager
+    from torchft_tpu.manager import Manager
+
+    for cls in (Manager, WireStubManager):
+        for name in ("bind_stage", "stage_index", "stage_count"):
+            assert callable(getattr(cls, name)), (cls, name)
+
+    stub = WireStubManager(DummyCommContext(), 1)
+    assert stub.stage_index() == 0 and stub.stage_count() == 1
+    stub.bind_stage(2, 4)
+    assert stub.stage_index() == 2 and stub.stage_count() == 4
+    snap = stub.metrics.snapshot()
+    assert snap["pipe_stage_index"] == 2.0
+    assert snap["pipe_stage_count"] == 4.0
+    with pytest.raises(ValueError):
+        stub.bind_stage(4, 4)
+
+
+def test_pipeline_adopts_manager_factory_surface():
+    from torchft_tpu.comm.context import DummyCommContext
+    from torchft_tpu.comm.wire_stub import WireStubManager
+
+    made = []
+
+    def factory(stage, replica):
+        mgr = WireStubManager(DummyCommContext(), 1)
+        made.append((stage, replica, mgr))
+        return mgr
+
+    pipe = Pipeline(
+        PipelineConfig(num_stages=2, replicas=1, microbatches=4,
+                       step_timeout=60.0),
+        manager_factory=factory,
+    )
+    try:
+        r = pipe.run_step()
+        assert not r["aborted"]
+        assert {(s, rr) for s, rr, _ in made} == {(0, 0), (1, 0)}
+        for stage, _, mgr in made:
+            assert mgr.stage_index() == stage
+            assert mgr.stage_count() == 2
+            # the pipeline emitted through the manager's own sinks
+            snap = mgr.metrics.snapshot()
+            assert snap["microbatch_send"] >= 0
+            assert snap["pipe_sched_ticks"] > 0
+            kinds = [e["kind"] for e in mgr.events.since(0)[0]]
+            assert "microbatch_recv" in kinds
+            assert "step_commit" in kinds
+    finally:
+        pipe.close()
+
+
+# --------------------------------------------------------------- config
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        PipelineConfig(num_stages=2, replicas=3, microbatches=4)
+    with pytest.raises(ValueError):
+        PipelineConfig(codec="lz4")
+    with pytest.raises(ValueError):
+        PipelineConfig(on_kill="retry")
+    cfg = PipelineConfig(num_stages=2, layer_dims=(8, 8, 8, 8, 8))
+    assert cfg.stage_layers == [[0, 1], [2, 3]]

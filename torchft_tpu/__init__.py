@@ -64,6 +64,10 @@ from torchft_tpu.optim import (  # noqa: F401
     ShardedOptimizerWrapper,
     ShardedOptState,
 )
+from torchft_tpu.pipeline import (  # noqa: F401
+    Pipeline,
+    PipelineConfig,
+)
 
 __all__ = [
     "AsyncCheckpointWriter",
@@ -81,6 +85,8 @@ __all__ = [
     "Manager",
     "Optimizer",
     "OptimizerWrapper",
+    "Pipeline",
+    "PipelineConfig",
     "PureDistributedDataParallel",
     "ShardedGradReducer",
     "ShardedOptimizerWrapper",

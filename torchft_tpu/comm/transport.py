@@ -89,9 +89,12 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "TcpCommContext",
+    "codec_decode_frame",
+    "codec_encode_frame",
     "codec_roundtrip",
     "codec_wire_nbytes",
     "host_unsupported_reason",
+    "make_wire_codec",
 ]
 
 _OP_ALLREDUCE = 1
@@ -668,6 +671,23 @@ _CODECS = {
 _NO_CODEC = _NoCodec()
 
 
+def make_wire_codec(name: str):
+    """Construct a standalone wire codec by name ("none" / "bf16" /
+    "fp16" / "int8") — THE public seam for other transport tiers that
+    compress whole frames with the allreduce wire's exact codecs (the
+    MPMD pipeline plane's stage-boundary act/grad frames,
+    torchft_tpu/pipeline.py). Codecs are stateless, so a fresh instance
+    per caller is free; error feedback stays the caller's job (the
+    codec only defines the wire's local image, exactly as
+    :func:`codec_roundtrip` documents)."""
+    try:
+        return _CODECS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown wire codec {name!r}; have {sorted(_CODECS)}"
+        ) from None
+
+
 def codec_roundtrip(codec, chunk_bytes: int, src: np.ndarray,
                     out: np.ndarray) -> None:
     """Write decode(encode(src)) into ``out``, chunked exactly as one
@@ -683,6 +703,24 @@ def codec_roundtrip(codec, chunk_bytes: int, src: np.ndarray,
         codec.decode_into(
             _iov_join(codec.encode_iovecs([ch_s])), [ch_o], copy
         )
+
+
+def codec_encode_frame(codec, flat: np.ndarray) -> bytes:
+    """Encode one whole flat array as a single wire-frame payload —
+    the point-to-point frame surface (pipeline act/grad hops), where a
+    tensor travels un-chunked: one frame, one codec image. The
+    allreduce planes keep their chunk-grid encoding
+    (:func:`codec_roundtrip`); the two must not be mixed, because the
+    int8 codec's per-chunk scale makes the images differ."""
+    return _iov_join(codec.encode_iovecs([np.ascontiguousarray(flat)]))
+
+
+def codec_decode_frame(codec, data: bytes, out: np.ndarray) -> None:
+    """Decode one :func:`codec_encode_frame` payload into ``out`` in
+    place (plain copy combine). Callers that need the wire's local
+    image for error feedback decode their own encoded bytes through
+    this — residuals stay bit-identical on both ends of the hop."""
+    codec.decode_into(data, [out], lambda v, inc: np.copyto(v, inc))
 
 
 def host_unsupported_reason(algorithm: str, compression: str,

@@ -84,6 +84,8 @@ class WireStubManager:
             set_events(self.events)
         self._use_async_quorum = True
         self._error = None
+        self._stage_index = 0
+        self._stage_count = 1
 
     def comm_backend(self) -> str:
         return str(getattr(self._ctx, "backend_name", "none"))
@@ -150,6 +152,26 @@ class WireStubManager:
     def transport_rank(self) -> int:
         rank = getattr(self._ctx, "rank", None)
         return int(rank()) if callable(rank) else 0
+
+    # -- pipeline-plane surface (mirrors Manager.bind_stage & co.) -----------
+
+    def bind_stage(self, stage_index: int, stage_count: int) -> None:
+        stage_index = int(stage_index)
+        stage_count = int(stage_count)
+        if not 0 <= stage_index < stage_count:
+            raise ValueError(
+                f"stage_index {stage_index} outside [0, {stage_count})"
+            )
+        self._stage_index = stage_index
+        self._stage_count = stage_count
+        self.metrics.gauge("pipe_stage_index", float(stage_index))
+        self.metrics.gauge("pipe_stage_count", float(stage_count))
+
+    def stage_index(self) -> int:
+        return self._stage_index
+
+    def stage_count(self) -> int:
+        return self._stage_count
 
     def allreduce_arrays(self, arrays, op=ReduceOp.SUM,
                          topology=None) -> Work:

@@ -335,6 +335,12 @@ class Manager:
         self._participating_world_size: int = 0
         self._replica_world_size: int = 0
         self._did_heal = False
+        # MPMD pipeline-plane placement (torchft_tpu/pipeline.py): which
+        # pipeline stage this Manager's replica group serves, out of how
+        # many. Defaults describe the degenerate 1-stage pipeline every
+        # non-pipelined job is.
+        self._stage_index = 0
+        self._stage_count = 1
         # One metrics sink for the whole step pipeline: the Manager's own
         # timers (quorum / commit_barrier / allreduce), the transport's
         # per-lane and per-op phase timers (comm_submit_wire /
@@ -674,6 +680,8 @@ class Manager:
             "participating": self._participating_rank is not None,
             "healing": self._healing,
             "batches_committed": self._batches_committed,
+            "stage_index": self._stage_index,
+            "stage_count": self._stage_count,
             # group's lighthouse (domain aggregator or root); None on
             # ranks that don't own the ManagerServer
             "lighthouse_addr": self._lighthouse_addr,
@@ -1330,6 +1338,31 @@ class Manager:
         shard the sharded weight update owns. Valid after
         ``wait_quorum``; 0 on a solo/observer wire."""
         return int(self._comm.rank())
+
+    def bind_stage(self, stage_index: int, stage_count: int) -> None:
+        """Declare this Manager's replica group a pipeline stage
+        (torchft_tpu/pipeline.py calls this once per stage replica).
+        Publishes ``pipe_stage_index``/``pipe_stage_count`` gauges so
+        the telemetry plane (and fleet_top) can render the pipeline
+        topology without pipeline-specific plumbing."""
+        stage_index = int(stage_index)
+        stage_count = int(stage_count)
+        if not 0 <= stage_index < stage_count:
+            raise ValueError(
+                f"stage_index {stage_index} outside [0, {stage_count})"
+            )
+        self._stage_index = stage_index
+        self._stage_count = stage_count
+        self.metrics.gauge("pipe_stage_index", float(stage_index))
+        self.metrics.gauge("pipe_stage_count", float(stage_count))
+
+    def stage_index(self) -> int:
+        """This replica group's pipeline stage (0 when not pipelined)."""
+        return self._stage_index
+
+    def stage_count(self) -> int:
+        """Pipeline depth this group is part of (1 when not pipelined)."""
+        return self._stage_count
 
     def is_solo_wire(self) -> bool:
         """True when THIS quorum's wire is an identity for this replica:

@@ -51,6 +51,18 @@ Event vocabulary (producers in parentheses):
                                       mesh shape, codec, dispatch /
                                       executable counts, compile-cache
                                       state)
+    microbatch_send / microbatch_recv
+                                     (pipeline.py: one activation/grad
+                                      frame crossed a stage boundary —
+                                      step, microbatch, lane, frame
+                                      kind, stages, bytes, replay flag;
+                                      the recv stream alone replays the
+                                      whole 1F1B schedule)
+    stage_rebalance                  (pipeline.py: layer ranges moved
+                                      between stages via the redist
+                                      planner — moved vs lower-bound
+                                      bytes, spec fingerprints, plan
+                                      cache state)
 
 Every event is stamped with a process-monotonic sequence number, wall +
 monotonic clocks, the bound replica_id/rank, and (when the emitter knows
@@ -107,6 +119,9 @@ EVENT_KINDS = (
     "reshard",
     "redist_plan",
     "fused_step",
+    "microbatch_send",
+    "microbatch_recv",
+    "stage_rebalance",
 )
 
 _DEFAULT_CAPACITY = 4096
