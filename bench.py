@@ -694,7 +694,8 @@ def _classic_overhead_phase(t0_step_ms=None) -> dict:
     holder: dict = {}
     try:
         lighthouse = Lighthouse(
-            min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=2000
+            min_replicas=1, join_timeout_ms=100, heartbeat_timeout_ms=2000,
+            lease_ms=2000,
         )
         store = StoreServer()
         manager = Manager(
@@ -781,6 +782,12 @@ def _classic_overhead_phase(t0_step_ms=None) -> dict:
             "overhead_ms_per_step_raw": round(overhead_ms_raw, 3),
             "inverted_measurement": inverted,
             "toy_ratio": round(ft_best / bare_best, 4),
+            # fast-path evidence for THIS phase's manager (solo wire):
+            # 0 RPCs on the last step + a fastpath step count covering
+            # the windows when TORCHFT_TPU_FASTPATH is on
+            "t1_control_rpcs_per_step": snap.get("control_rpcs_per_step"),
+            "t1_fastpath_steps": int(snap.get("fastpath_steps") or 0),
+            "t1_fallback_steps": int(snap.get("fallback_steps") or 0),
             "phase_ms": {
                 k[: -len("_avg_ms")]: round(v, 3)
                 for k, v in snap.items() if k.endswith("_avg_ms")
@@ -1631,6 +1638,10 @@ def _run() -> None:
     lighthouse = Lighthouse(
         min_replicas=1, join_timeout_ms=500,
         heartbeat_timeout_ms=800,
+        # Epoch leases ON: the steady-state fast path (zero control RPCs
+        # per step) engages whenever the fleet is stable; the A/B lever
+        # is BENCH_FASTPATH on the manager side, not here.
+        lease_ms=2000,
     )
     store = StoreServer()
     params_ft, opt_init = t1_initial_state
@@ -1818,6 +1829,9 @@ def _run() -> None:
     # length) bring-up steps
     t1_committed_before, t1_attempted_before = committed, attempted
     t1_fused_before, t1_classic_before = opt.fused_steps, opt.classic_steps
+    _m0 = manager.metrics.snapshot()
+    t1_fastpath_before = float(_m0.get("fastpath_steps") or 0.0)
+    t1_fallback_before = float(_m0.get("fallback_steps") or 0.0)
     opt.metrics.reset_timings()  # breakdown must describe the window
     t_start = time.perf_counter()
     for _ in range(steps):
@@ -1886,6 +1900,20 @@ def _run() -> None:
     _PARTIAL["t1_events_recorded"] = int(
         getattr(getattr(manager, "events", None), "next_seq", 0) or 0
     )
+    # Steady-state fast path (ISSUE 18): control RPCs the LAST T1 step
+    # issued (exactly 0 when the epoch lease + data-plane vote carried
+    # it) and the T1 window's fastpath/fallback step mix. BENCH_FASTPATH=0
+    # is the A/B lever (mapped onto TORCHFT_TPU_FASTPATH in main()).
+    t1_control_rpcs = _m.get("control_rpcs_per_step")
+    t1_fastpath = (
+        float(_m.get("fastpath_steps") or 0.0) - t1_fastpath_before
+    )
+    t1_fallback = (
+        float(_m.get("fallback_steps") or 0.0) - t1_fallback_before
+    )
+    _PARTIAL["t1_control_rpcs_per_step"] = t1_control_rpcs
+    _PARTIAL["t1_fastpath_steps"] = int(t1_fastpath)
+    _PARTIAL["t1_fallback_steps"] = int(t1_fallback)
     # Step-pipeline stage breakdown (per-bucket d2h/ef/wire/h2d wall
     # times recorded by the DDP wrapper into the manager's sink) and the
     # overlap gauge: t1_pipeline_overlap = 1 - exposed/total, where
@@ -1954,6 +1982,7 @@ def _run() -> None:
     )
     t2 = chaos_commit_rate = None
     chaos_fused = chaos_classic = None
+    chaos_fastpath_steps = chaos_control_rpcs = None
     chaos_participants_end = chaos_world_end = None
     chaos_respawn = None
     chaos_heal_ms = None
@@ -2000,6 +2029,8 @@ def _run() -> None:
             committed_before, attempted_before = committed, attempted
             chaos_fused_before = opt.fused_steps
             chaos_classic_before = opt.classic_steps
+            _cm0 = manager.metrics.snapshot()
+            chaos_fastpath_before = float(_cm0.get("fastpath_steps") or 0.0)
             t_start = time.perf_counter()
             kill_at = t_start + chaos_seconds / 4
             respawn_at = None
@@ -2070,6 +2101,15 @@ def _run() -> None:
             chaos_participants_end = manager.num_participants()
             chaos_fused = opt.fused_steps - chaos_fused_before
             chaos_classic = opt.classic_steps - chaos_classic_before
+            # Fast-path behavior THROUGH the kill: the lease must break
+            # on the membership edge (full-path steps around the kill)
+            # and re-arm once the fleet is stable again.
+            _cm1 = manager.metrics.snapshot()
+            chaos_fastpath_steps = int(
+                float(_cm1.get("fastpath_steps") or 0.0)
+                - chaos_fastpath_before
+            )
+            chaos_control_rpcs = _cm1.get("control_rpcs_per_step")
 
     if trace_path:
         with open(trace_path, "w") as f:
@@ -2205,6 +2245,16 @@ def _run() -> None:
             "chaos_heal_ms": chaos_heal_ms,
             "chaos_fused_steps": chaos_fused,
             "chaos_classic_steps": chaos_classic,
+            "chaos_fastpath_steps": chaos_fastpath_steps,
+            "chaos_control_rpcs_per_step": chaos_control_rpcs,
+            "t1_control_rpcs_per_step": (
+                _PARTIAL.get("t1_control_rpcs_per_step")
+            ),
+            "t1_fastpath_steps": _PARTIAL.get("t1_fastpath_steps"),
+            "t1_fallback_steps": _PARTIAL.get("t1_fallback_steps"),
+            "bench_fastpath": (
+                os.environ.get("TORCHFT_TPU_FASTPATH", "1") != "0"
+            ),
             "localsgd": sync_results["localsgd"],
             "diloco": sync_results["diloco"],
             "classic_overhead": classic_overhead,
@@ -2225,6 +2275,11 @@ def _run() -> None:
 
 
 def main() -> None:
+    # BENCH_FASTPATH=0 pins every Manager (parent AND spawned children —
+    # the env is inherited) onto the per-step quorum/barrier path: the
+    # A/B lever for the steady-state fast path (ISSUE 18).
+    if "BENCH_FASTPATH" in os.environ:
+        os.environ["TORCHFT_TPU_FASTPATH"] = os.environ["BENCH_FASTPATH"]
     if os.environ.get("BENCH_ROLE") == "child":
         _child_main()
         return

@@ -84,7 +84,7 @@ void ft_free(char* p) { free(p); }
 // so the ABI stays stable as options grow:
 //   {"cache_quorum": bool, "prune_after_ms": int, "tier": int,
 //    "domain": str, "upstream_addr": str,
-//    "upstream_report_interval_ms": int}
+//    "upstream_report_interval_ms": int, "lease_ms": int}
 // NULL or "" keeps every default (cached decisions, root tier).
 void* ft_lighthouse_new(const char* bind_host, int port, const char* hostname,
                         uint64_t min_replicas, uint64_t join_timeout_ms,
@@ -108,6 +108,7 @@ void* ft_lighthouse_new(const char* bind_host, int port, const char* hostname,
       opts.upstream_addr = extra.get_str("upstream_addr", "");
       opts.upstream_report_interval_ms = static_cast<uint64_t>(
           extra.get_int("upstream_report_interval_ms", 500));
+      opts.lease_ms = extra.get_int("lease_ms", 0);
     }
     auto lh = std::make_unique<ftlighthouse::Lighthouse>(std::move(opts));
     lh->start();
@@ -206,6 +207,25 @@ char* ft_manager_client_quorum(void* handle, int64_t rank, int64_t step,
   req["comm_epoch"] = comm_epoch;
   std::string out;
   if (!client_post(c, "/torchft.ManagerService/Quorum",
+                   ftjson::Value(req).dump(),
+                   static_cast<int64_t>(timeout_ms), &out, err)) {
+    return nullptr;
+  }
+  return dup_string(out);
+}
+
+// Epoch-lease renewal long-poll: parks on the manager's EpochWatch proxy
+// (which carries one lighthouse EpochWatch for the whole group) until
+// the membership epoch moves off `epoch` or ~timeout_ms elapses. Returns
+// the JSON body {"epoch": int, "changed": bool} — changed=false at the
+// deadline IS the renewal.
+char* ft_manager_client_epoch_watch(void* handle, int64_t epoch,
+                                    uint64_t timeout_ms, char** err) {
+  auto* c = static_cast<ClientHandle*>(handle);
+  ftjson::Object req;
+  req["epoch"] = epoch;
+  std::string out;
+  if (!client_post(c, "/torchft.ManagerService/EpochWatch",
                    ftjson::Value(req).dump(),
                    static_cast<int64_t>(timeout_ms), &out, err)) {
     return nullptr;

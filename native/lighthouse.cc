@@ -169,6 +169,15 @@ void Lighthouse::tick_loop() {
 void Lighthouse::tick_locked() {
   const auto& decision = iq_.decision(fthttp::now_ms());
   last_reason_ = decision.reason;
+  // Epoch-watch wakeup: decision()'s sweep (expiry/prune) and any join
+  // since the last tick may have bumped the membership epoch without an
+  // announcement. Parked EpochWatch waiters key their lease validity on
+  // exactly this edge, so notify them here — detection latency is then
+  // bounded by quorum_tick_ms instead of the watch re-stamp interval.
+  if (iq_.epoch() != watched_epoch_) {
+    watched_epoch_ = iq_.epoch();
+    cv_.notify_all();
+  }
   if (!decision.quorum.has_value()) return;
 
   // install() bumps the quorum id only when membership changed (ref
@@ -180,6 +189,15 @@ void Lighthouse::tick_locked() {
   // bytes verbatim instead of re-rendering an O(n) member list per RPC.
   ftjson::Object reply;
   reply["quorum"] = q.to_json();
+  // Epoch lease (sampled AFTER install's epoch bump, so the granted
+  // epoch is exactly the one a stable fleet keeps): while a manager's
+  // EpochWatch sees this epoch unchanged and the lease window has not
+  // expired, it may step with zero control RPCs. Any join / expiry /
+  // announcement bumps the epoch and invalidates every outstanding
+  // lease — the full Quorum path below is the always-correct fallback.
+  reply["membership_epoch"] = static_cast<int64_t>(iq_.epoch());
+  reply["lease_ms"] = opts_.lease_ms;
+  watched_epoch_ = iq_.epoch();
   latest_quorum_body_ = ftjson::Value(std::move(reply)).dump();
   latest_quorum_ids_.clear();
   for (const auto& p : q.participants) {
@@ -193,6 +211,10 @@ Response Lighthouse::handle(const Request& req) {
   if (req.path == "/torchft.LighthouseService/Quorum" &&
       req.method == "POST") {
     return handle_quorum(req);
+  }
+  if (req.path == "/torchft.LighthouseService/EpochWatch" &&
+      req.method == "POST") {
+    return handle_epoch_watch(req);
   }
   if (req.path == "/torchft.LighthouseService/Heartbeat" &&
       req.method == "POST") {
@@ -332,7 +354,85 @@ Response Lighthouse::handle_quorum(const Request& req) {
     iq_.join(now2, requester);
   }
 
+  if (opts_.lease_ms > 0) lease_grants_ += 1;
   return Response{200, "application/json", latest_quorum_body_};
+}
+
+Response Lighthouse::handle_epoch_watch(const Request& req) {
+  // Lease renewal long-poll: park while the membership epoch equals the
+  // watched one, re-stamping the requester's heartbeat (same liveness
+  // piggyback as handle_quorum — a parked watch IS the replica's
+  // heartbeat, native/manager.cc heartbeat_loop). Returns
+  // {epoch, changed}: changed=false at the deadline is a lease renewal;
+  // changed=true means the fleet moved and the caller's lease is dead.
+  std::string replica_id;
+  uint64_t watched = 0;
+  try {
+    auto body = ftjson::Value::parse(req.body);
+    replica_id = body.get_str("replica_id");
+    watched = static_cast<uint64_t>(body.get_int("epoch"));
+  } catch (const std::exception& e) {
+    return Response{400, "application/json",
+                    std::string("{\"error\":\"bad request: ") + e.what() +
+                        "\"}"};
+  }
+
+  std::unique_lock<std::mutex> lk(mu_);
+  epoch_watch_rpcs_ += 1;
+  int64_t entry = fthttp::now_ms();
+  iq_.heartbeat(replica_id, entry);
+  const int64_t stamp_interval = std::max<int64_t>(
+      1, static_cast<int64_t>(opts_.quorum.heartbeat_timeout_ms) / 4);
+  // Return a margin BEFORE the RPC deadline: the renewal response must
+  // clear the proxy hop and the client's socket guard, or every renewal
+  // would race its own timeout and read as a lease break.
+  const int64_t window = req.deadline_ms - entry;
+  const int64_t watch_deadline =
+      req.deadline_ms -
+      std::min<int64_t>(1000, std::max<int64_t>(20, window / 10));
+
+  while (iq_.epoch() == watched && !stopping_ &&
+         fthttp::now_ms() < watch_deadline) {
+    int64_t now = fthttp::now_ms();
+    int64_t wake = std::min(watch_deadline, now + stamp_interval);
+    auto deadline =
+        std::chrono::steady_clock::now() +
+        std::chrono::milliseconds(std::max<int64_t>(1, wake - now));
+    if (cv_.wait_until(lk, deadline) == std::cv_status::timeout &&
+        iq_.epoch() == watched) {
+      // Run the (cached) decision so expiry edges are observed even if
+      // the tick thread is briefly behind; a dead member must break
+      // leases from the watch itself, not only from the next tick.
+      (void)iq_.decision(fthttp::now_ms());
+      if (iq_.epoch() != watched) break;
+      if (fthttp::now_ms() >= watch_deadline) break;
+      // Dead-client probe, as in handle_quorum: a SIGKILLed watcher
+      // must expire after heartbeat_timeout, not look alive until the
+      // RPC deadline.
+      if (req.client_fd >= 0) {
+        char probe;
+        ssize_t pr = ::recv(req.client_fd, &probe, 1,
+                            MSG_PEEK | MSG_DONTWAIT);
+        if (pr == 0 || (pr < 0 && errno != EAGAIN &&
+                        errno != EWOULDBLOCK && errno != EINTR)) {
+          return Response{503, "application/json",
+                          "{\"error\":\"client disconnected\"}"};
+        }
+      }
+      iq_.heartbeat(replica_id, fthttp::now_ms());
+    }
+  }
+  if (stopping_) {
+    return Response{503, "application/json",
+                    "{\"error\":\"lighthouse shutting down\"}"};
+  }
+  bool changed = iq_.epoch() != watched;
+  if (changed) lease_breaks_ += 1;
+  ftjson::Object out;
+  out["epoch"] = static_cast<int64_t>(iq_.epoch());
+  out["changed"] = changed;
+  return Response{200, "application/json",
+                  ftjson::Value(std::move(out)).dump()};
 }
 
 Response Lighthouse::handle_heartbeat(const Request& req) {
@@ -494,6 +594,10 @@ Response Lighthouse::handle_status_json() {
         static_cast<int64_t>(iq_.pruned_heartbeats());
     ctl["participants_pruned"] =
         static_cast<int64_t>(iq_.pruned_participants());
+    ctl["lease_grants"] = static_cast<int64_t>(lease_grants_);
+    ctl["lease_breaks"] = static_cast<int64_t>(lease_breaks_);
+    ctl["epoch_watch_rpcs"] = static_cast<int64_t>(epoch_watch_rpcs_);
+    ctl["lease_ms"] = opts_.lease_ms;
     ctl["healthy_replicas"] = static_cast<int64_t>(iq_.healthy_count());
     ctl["tier"] = static_cast<int64_t>(opts_.tier);
     ctl["domain"] = opts_.domain;

@@ -67,6 +67,11 @@ struct LighthouseOpts {
   std::string domain = "";         // domain (rack/ICI) name, "" = unnamed
   std::string upstream_addr = "";  // root lighthouse; "" = this IS the root
   uint64_t upstream_report_interval_ms = 500;
+  // Epoch-lease duration granted with every Quorum response (<=0: leases
+  // disabled). A manager holding a live lease steps without control RPCs
+  // and renews it off the step path via the EpochWatch long-poll; any
+  // membership-epoch bump observed by a watch breaks the lease.
+  int64_t lease_ms = 0;
 };
 
 // One aggregator's latest upstream summary, as stored by the root.
@@ -94,6 +99,7 @@ class Lighthouse {
  private:
   fthttp::Response handle(const fthttp::Request& req);
   fthttp::Response handle_quorum(const fthttp::Request& req);
+  fthttp::Response handle_epoch_watch(const fthttp::Request& req);
   fthttp::Response handle_heartbeat(const fthttp::Request& req);
   fthttp::Response handle_domain_report(const fthttp::Request& req);
   fthttp::Response handle_status();
@@ -128,6 +134,16 @@ class Lighthouse {
   uint64_t quorum_rpcs_ = 0;
   uint64_t domain_reports_ = 0;
   uint64_t domains_pruned_ = 0;
+  // Steady-state fast path (leases): quorum responses that carried a
+  // lease grant / EpochWatch long-polls served / watches that observed
+  // an epoch bump (each one invalidates a manager's lease).
+  uint64_t lease_grants_ = 0;
+  uint64_t epoch_watch_rpcs_ = 0;
+  uint64_t lease_breaks_ = 0;
+  // Last epoch tick_locked saw: an epoch edge from ANY source (join,
+  // expiry sweep, install) wakes parked EpochWatch waiters within one
+  // tick instead of their next re-stamp interval.
+  uint64_t watched_epoch_ = 0;
 
   // Root side of the two-level tree: domain name -> latest summary.
   // Rows silent for far longer than their advertised interval are

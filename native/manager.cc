@@ -95,6 +95,8 @@ Response ManagerServer::handle(const Request& req) {
   if (req.method != "POST") return Response{404, "text/plain", "not found"};
   if (req.path == "/torchft.ManagerService/Quorum")
     return handle_quorum(req);
+  if (req.path == "/torchft.ManagerService/EpochWatch")
+    return handle_epoch_watch(req);
   if (req.path == "/torchft.ManagerService/CheckpointMetadata")
     return handle_checkpoint_metadata(req);
   if (req.path == "/torchft.ManagerService/ShouldCommit")
@@ -176,6 +178,10 @@ Response ManagerServer::handle_quorum(const Request& req) {
     try {
       auto parsed = ftjson::Value::parse(res.body);
       latest_quorum_ = QuorumInfo::from_json(parsed.get("quorum"));
+      // Epoch lease (absent on pre-lease lighthouses: defaults keep the
+      // fast path disarmed).
+      latest_membership_epoch_ = parsed.get_int("membership_epoch", 0);
+      latest_lease_ms_ = parsed.get_int("lease_ms", 0);
     } catch (const std::exception& e) {
       ftjson::Object err;
       err["error"] = std::string("bad lighthouse response: ") + e.what();
@@ -205,12 +211,63 @@ Response ManagerServer::handle_quorum(const Request& req) {
     auto results =
         ftquorum::compute_quorum_results(opts_.replica_id, rank,
                                          *latest_quorum_);
-    return Response{200, "application/json", results.to_json().dump()};
+    auto out = results.to_json();
+    auto& obj = out.as_object();
+    obj["membership_epoch"] = latest_membership_epoch_;
+    obj["lease_ms"] = latest_lease_ms_;
+    return Response{200, "application/json", out.dump()};
   } catch (const std::exception& e) {
     ftjson::Object err;
     err["error"] = e.what();
     return Response{500, "application/json", ftjson::Value(err).dump()};
   }
+}
+
+Response ManagerServer::handle_epoch_watch(const Request& req) {
+  // Lease-renewal proxy: carry ONE lighthouse EpochWatch on behalf of
+  // this replica group. While the watch is parked upstream it doubles as
+  // the group's liveness signal (the lighthouse re-stamps parked
+  // waiters), so the heartbeat loop piggybacks on it exactly like it
+  // does on an in-flight Quorum RPC.
+  int64_t epoch;
+  try {
+    epoch = ftjson::Value::parse(req.body).get_int("epoch");
+  } catch (const std::exception& e) {
+    return Response{400, "application/json",
+                    std::string("{\"error\":\"") + e.what() + "\"}"};
+  }
+  ftjson::Object lh_req;
+  lh_req["replica_id"] = opts_.replica_id;
+  lh_req["epoch"] = epoch;
+  std::string host;
+  int port = 0;
+  fthttp::parse_http_addr(opts_.lighthouse_addr, &host, &port);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    lighthouse_inflight_ += 1;  // heartbeat loop piggybacks on the watch
+  }
+  auto res = fthttp::http_post(host, port,
+                               "/torchft.LighthouseService/EpochWatch",
+                               ftjson::Value(lh_req).dump(),
+                               req.deadline_ms);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    lighthouse_inflight_ -= 1;
+    if (res.error.empty() && res.status == 200) {
+      last_lighthouse_contact_ms_ = fthttp::now_ms();
+    }
+  }
+  if (!res.error.empty() || res.status != 200) {
+    std::string msg = !res.error.empty()
+                          ? res.error
+                          : ("lighthouse status " +
+                             std::to_string(res.status) + ": " + res.body);
+    int status = (res.timed_out || res.status == 504) ? 504 : 500;
+    ftjson::Object err;
+    err["error"] = "lighthouse epoch watch failed: " + msg;
+    return Response{status, "application/json", ftjson::Value(err).dump()};
+  }
+  return Response{200, "application/json", res.body};
 }
 
 Response ManagerServer::handle_checkpoint_metadata(const Request& req) {

@@ -59,6 +59,7 @@ class ManagerServer {
  private:
   fthttp::Response handle(const fthttp::Request& req);
   fthttp::Response handle_quorum(const fthttp::Request& req);
+  fthttp::Response handle_epoch_watch(const fthttp::Request& req);
   fthttp::Response handle_checkpoint_metadata(const fthttp::Request& req);
   fthttp::Response handle_should_commit(const fthttp::Request& req);
   fthttp::Response handle_kill(const fthttp::Request& req);
@@ -91,6 +92,12 @@ class ManagerServer {
   std::map<int64_t, int64_t> comm_epochs_;
   uint64_t quorum_seq_ = 0;
   std::optional<ftquorum::QuorumInfo> latest_quorum_;
+  // Epoch lease riding the lighthouse Quorum response (steady-state
+  // fast path): the membership epoch the lease was granted at and its
+  // duration (0 = no lease). Appended to every local rank's quorum
+  // response so the Python manager can arm its fast path.
+  int64_t latest_membership_epoch_ = 0;
+  int64_t latest_lease_ms_ = 0;
 
   // ShouldCommit barrier state. Rounds are keyed by step so a retried
   // vote (pooled-connection resend after a lost reply) can never leak

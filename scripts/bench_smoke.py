@@ -983,6 +983,92 @@ def pipeline_smoke() -> "list[str]":
     return failures
 
 
+def fastpath_smoke() -> "list[str]":
+    """Steady-state fast path (ISSUE 18), in-process: a solo Manager over
+    a lease-granting lighthouse steps until the lease arms, then every
+    further committed step must issue EXACTLY 0 control RPCs; the
+    fastpath/fallback/lease counters must exist and be finite; and an
+    injected error mid-lease must NOT commit (the full-barrier fallback
+    is the only path that may decide a faulted step)."""
+    import math
+
+    import numpy as np
+
+    from torchft_tpu.comm.store import StoreServer
+    from torchft_tpu.control import Lighthouse
+    from torchft_tpu.manager import Manager
+
+    failures: "list[str]" = []
+    lighthouse = Lighthouse(
+        min_replicas=1, join_timeout_ms=100, quorum_tick_ms=10,
+        lease_ms=2000,
+    )
+    store = StoreServer()
+    manager = None
+    try:
+        manager = Manager(
+            min_replica_size=1,
+            timeout=20.0, quorum_timeout=20.0, connect_timeout=20.0,
+            rank=0, world_size=1,
+            store_addr=store.addr,
+            lighthouse_addr=lighthouse.address(),
+            replica_id="fastpath_smoke_",
+            heartbeat_interval=0.05,
+            use_async_quorum=False,
+        )
+
+        def _step() -> bool:
+            manager.start_quorum(allow_heal=False)
+            manager.allreduce_arrays(
+                [np.ones(8, np.float32)]
+            ).future().result(timeout=20)
+            return manager.should_commit()
+
+        # step 0 arms the lease through the full path; steps 1-4 must be
+        # zero-RPC steady state
+        for i in range(5):
+            if not _step():
+                failures.append(f"fastpath smoke: step {i} did not commit")
+            elif i >= 1 and manager._control_rpcs != 0:
+                failures.append(
+                    f"fastpath smoke: steady-state step {i} issued "
+                    f"{manager._control_rpcs} control RPCs (want 0)"
+                )
+        snap = manager.metrics.snapshot()
+        for key in ("fastpath_steps", "fallback_steps", "lease_grants",
+                    "control_rpcs_per_step"):
+            v = snap.get(key)
+            if v is None or not math.isfinite(float(v)) or float(v) < 0:
+                failures.append(
+                    f"fastpath smoke: counter {key!r} "
+                    f"missing/non-finite: {v!r}"
+                )
+        if float(snap.get("fastpath_steps") or 0) < 4:
+            failures.append(
+                "fastpath smoke: expected >= 4 fastpath steps, got "
+                f"{snap.get('fastpath_steps')!r}"
+            )
+        # injected error mid-lease: must discard, never fast-commit
+        manager.start_quorum(allow_heal=False)
+        manager.report_error(RuntimeError("fastpath_smoke injected"))
+        if manager.should_commit():
+            failures.append(
+                "fastpath smoke: step with an injected error COMMITTED"
+            )
+        if manager._lease_valid():
+            failures.append(
+                "fastpath smoke: latch edge did not break the lease"
+            )
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"fastpath smoke: round failed: {e!r}")
+    finally:
+        if manager is not None:
+            manager.shutdown(wait=False)
+        store.shutdown()
+        lighthouse.shutdown()
+    return failures
+
+
 def main() -> int:
     env = {
         k: v for k, v in os.environ.items()
@@ -1034,6 +1120,7 @@ def main() -> int:
     failures += fused_smoke()
     failures += fleet_smoke()
     failures += pipeline_smoke()
+    failures += fastpath_smoke()
     for key in ("t1_pipeline_overlap", "t1_pipeline_ms", "t1_ddp_streamed",
                 "t1_overhead_ms", "t1_outer_overlap", "t1_outer_wire_ms",
                 "comm_backend", "t1_events_recorded",

@@ -174,6 +174,8 @@ def build_row(ep: Dict[str, Any],
         "mode": None,
         "committed": None,
         "discarded": None,
+        "lease": None,
+        "rpc_step": None,
         "allreduce_p50_ms": None,
         "heal_mb_s": None,
         "ddp_overlap": None,
@@ -208,6 +210,21 @@ def build_row(ep: Dict[str, Any],
         row["mode"] = "fused" if float(execs) <= 1 else "staged"
     row["committed"] = m.get("steps_committed")
     row["discarded"] = m.get("steps_discarded")
+    # Steady-state fast path (ISSUE 18): which epoch this replica's lease
+    # covers (or "-" if it is stepping through the full quorum/barrier
+    # path) and how many control RPCs the current step issued — a stable
+    # fleet shows `e<N>` and 0 on every row; any latch/membership edge
+    # flips a row to "-" with ≥2 for exactly the fallback steps.
+    lease_live = tel.get("lease_live")
+    if lease_live is not None:
+        lease_epoch = tel.get("lease_epoch")
+        row["lease"] = (
+            f"e{lease_epoch}" if lease_live and lease_epoch is not None
+            else ("live" if lease_live else "-")
+        )
+    rpcs = tel.get("control_rpcs_per_step")
+    if rpcs is not None:
+        row["rpc_step"] = int(rpcs)
     row["allreduce_p50_ms"] = m.get("allreduce_p50_ms")
     bps = m.get("heal_wire_bytes_per_s") or m.get("heal_bytes_per_s")
     row["heal_mb_s"] = None if bps is None else bps / 1e6
@@ -268,7 +285,8 @@ def build_row(ep: Dict[str, Any],
 _COLUMNS = (
     ("replica", 34), ("rank", 4), ("step", 6), ("epoch", 5),
     ("mesh", 5), ("mode", 6),
-    ("committed", 9), ("discarded", 9), ("allreduce_p50_ms", 16),
+    ("committed", 9), ("discarded", 9), ("lease", 6), ("rpc_step", 8),
+    ("allreduce_p50_ms", 16),
     ("heal_mb_s", 9), ("ddp_overlap", 11), ("outer_overlap", 13),
     ("stage", 5), ("inflight", 8), ("bubble", 6),
     ("d_intra_mb", 10), ("d_inter_mb", 10), ("redist_waste_mb", 15),

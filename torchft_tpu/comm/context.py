@@ -231,6 +231,27 @@ class CommContext(ABC):
         """Latched transport error, if any (cleared by configure)."""
         return None
 
+    # ------------------------------------------- data-plane commit votes
+    # Backends that can fold a 1-byte health vote into their collectives
+    # (host wire frames, xla psum) override these; the defaults describe
+    # a backend with no vote channel, which the Manager's steady-state
+    # fast path treats as ABSENT — it falls back to the full two-phase
+    # should_commit barrier (never commits on weaker evidence).
+
+    def set_vote_health(self, fn) -> None:  # noqa: B027 — optional hook
+        """Install the local health provider for data-plane votes:
+        ``fn() -> bool`` (True = healthy). Backends without a vote
+        channel ignore it."""
+
+    def take_commit_vote(self) -> "Optional[bool]":
+        """Windowed aggregate of the health votes that rode this
+        backend's collectives since the last call: True when at least
+        one voted op completed and EVERY participant reported healthy,
+        False when any participant dissented, None when no voted op
+        completed (vote absent — the caller must use the full commit
+        barrier). Default: votes are never present."""
+        return None
+
     # ----------------------------------------------- wire introspection
     # Implementations with a real wire (TcpCommContext) override these;
     # the defaults describe an identity wire. Consumers: the DDP
@@ -420,6 +441,12 @@ class ErrorSwallowingCommContext(CommContext):
 
     def wire_nbytes(self, a: np.ndarray) -> int:
         return self._inner.wire_nbytes(a)
+
+    def set_vote_health(self, fn) -> None:
+        self._inner.set_vote_health(fn)
+
+    def take_commit_vote(self) -> "Optional[bool]":
+        return self._inner.take_commit_vote()
 
     # instance-level shadow of the classmethod: capability follows the
     # wrapped backend, not this wrapper's (identity) default

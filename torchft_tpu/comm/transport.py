@@ -906,6 +906,11 @@ class _Lane:
 
             _time.sleep(delay)
         if self._world_size == 1:
+            if p.opcode in _GRAD_OPCODES:
+                # Solo wire: the op's vote is this rank's own health —
+                # the degenerate (but still present) data-plane evidence
+                # the Manager's fast path consumes.
+                self._ctx._record_vote(self._ctx._vote_health_bit())
             if p.opcode == _OP_ALLGATHER:
                 return [p.arrays]
             return p.arrays
@@ -936,8 +941,11 @@ class _Lane:
         return self._execute_peer(p)
 
     def _check_header(self, peer_rank: int, sock: socket.socket,
-                      opcode: int) -> None:
-        r_op, r_seq, _op = struct.unpack(
+                      opcode: int) -> int:
+        """Validate one peer->root frame header and return its third
+        byte — the sender's health-vote bit on the gradient opcodes
+        (0 = healthy), always 0 on the others."""
+        r_op, r_seq, r_vote = struct.unpack(
             "<BQB", self._bufs.recv_header(sock, 10)
         )
         if r_op != opcode or r_seq != self._seq:
@@ -946,8 +954,17 @@ class _Lane:
                 f"got op={r_op} seq={r_seq}, expected op={opcode} "
                 f"seq={self._seq}"
             )
+        return r_vote & 1
 
-    # Star ALLREDUCE/REDUCE_SCATTER frames (both directions): per chunk,
+    # Star ALLREDUCE/REDUCE_SCATTER frames carry the step's commit vote
+    # for free: the peer->root header's third byte (previously always 0)
+    # is the sender's health bit, and after the last reply chunk the root
+    # appends ONE aggregate byte (own | OR(peers)) to every peer — so
+    # each voted op tells every rank whether ANY participant is unhealthy
+    # without a single extra round trip (the Manager's zero-RPC
+    # should_commit evidence). Votes ride ONLY the gradient opcodes.
+    #
+    # Frames otherwise (both directions): per chunk,
     # [nbytes u64] + the codec's raw encoded stream over that chunk view —
     # shapes are known on both sides (both ops require identical
     # layouts), so the self-describing _pack_arrays framing is skipped and
@@ -971,8 +988,9 @@ class _Lane:
             raise ValueError(f"unsupported reduce op: {p.op}")
         peers = sorted(self._peer_socks.items())
         peer_socks = dict(peers)
+        vote = self._ctx._vote_health_bit()
         for peer_rank, sock in peers:
-            self._check_header(peer_rank, sock, p.opcode)
+            vote |= self._check_header(peer_rank, sock, p.opcode)
         copy = lambda v, inc: np.copyto(v, inc)  # noqa: E731
         lossy = type(codec) is not _NoCodec
         owners = p.owners if p.opcode == _OP_REDUCE_SCATTER else None
@@ -1021,6 +1039,14 @@ class _Lane:
                 _sendmsg_all(sock, frame)
             if lossy:
                 codec.decode_into(_iov_join(enc), [ch], copy)
+        # Commit vote, aggregated at the root: one trailing byte per
+        # peer after the last reply chunk (REDUCE_SCATTER owners with
+        # zero reply chunks still get it — the vote is the op's only
+        # root->peer traffic for them).
+        vote_frame = [struct.pack("<B", vote)]
+        for _, sock in peers:
+            _sendmsg_all(sock, vote_frame)
+        self._ctx._record_vote(vote)
 
     def _star_allreduce_peer_chunks(
         self, p: _PendingOp, sock: socket.socket
@@ -1044,7 +1070,9 @@ class _Lane:
         # socket in one select-driven loop — chunk k+1 ships while the
         # root still reduces chunk k, replies drain as they land, and
         # neither direction can deadlock on full socket buffers.
-        tx: List = [struct.pack("<BQB", p.opcode, self._seq, 0)]
+        tx: List = [struct.pack(
+            "<BQB", p.opcode, self._seq, self._ctx._vote_health_bit()
+        )]
         for ch in chunks:
             enc = codec.encode_iovecs([ch])
             tx.append(struct.pack("<Q", _iov_nbytes(enc)))
@@ -1066,6 +1094,11 @@ class _Lane:
                 # decode runs between fills — before the slot's next
                 # reuse, same contract as the blocking path
                 codec.decode_into(payload, [ch], copy)
+            # trailing aggregate commit vote from the root (see the
+            # frame comment above _star_allreduce_root_chunks)
+            vote_mv = self._bufs.header_slot(1)
+            yield vote_mv
+            self._ctx._record_vote(vote_mv[0])
 
         _duplex_exchange(sock, tx, sock, _rx_targets(), self._timeout)
 
@@ -1125,11 +1158,18 @@ class _Lane:
 
     # ---------------------------------------------------------- ring variant
 
-    _RING_HDR = struct.Struct("<BQHQ")  # opcode, seq, step, payload bytes
+    # opcode, seq, step, payload bytes, vote: the vote byte is the
+    # sender's accumulated unhealthy-OR on the gradient opcodes (each
+    # rank forwards own | everything-received-so-far, so after the n-1
+    # reduce-scatter hops every rank holds the OR over ALL ranks — the
+    # ring analog of the star root's aggregate byte), always 0 on the
+    # others.
+    _RING_HDR = struct.Struct("<BQHQB")
 
     def _ring_sendrecv(
-        self, opcode: int, step: int, bufs: Sequence, nbytes: int
-    ) -> memoryview:
+        self, opcode: int, step: int, bufs: Sequence, nbytes: int,
+        vote: int = 0,
+    ) -> "tuple[memoryview, int]":
         """Full-duplex one-step exchange: push to next while pulling from
         prev, interleaved in THIS thread by the select-driven
         _duplex_exchange (deadlock-free like the old sender-thread
@@ -1148,20 +1188,24 @@ class _Lane:
         while that hop's frame streams into the other slot."""
         next_sock, prev_sock = self._next_sock, self._prev_sock
         assert next_sock is not None and prev_sock is not None
-        header = self._RING_HDR.pack(opcode, self._seq, step, nbytes)
+        header = self._RING_HDR.pack(opcode, self._seq, step, nbytes, vote)
         hdr_size = self._RING_HDR.size
         out: List[memoryview] = []
+        rvotes: List[int] = []
 
         def _rx_targets():
             hdr_mv = self._bufs.header_slot(hdr_size)
             yield hdr_mv
-            r_op, r_seq, r_step, r_len = self._RING_HDR.unpack(hdr_mv)
+            r_op, r_seq, r_step, r_len, r_vote = self._RING_HDR.unpack(
+                hdr_mv
+            )
             if (r_op, r_seq, r_step) != (opcode, self._seq, step):
                 raise ConnectionError(
                     f"ring collective mismatch: got op={r_op} seq={r_seq} "
                     f"step={r_step}, expected op={opcode} seq={self._seq} "
                     f"step={step}"
                 )
+            rvotes.append(r_vote & 1)
             if r_len == 0:
                 out.append(memoryview(b""))
                 return
@@ -1173,7 +1217,7 @@ class _Lane:
             next_sock, [header, *bufs], prev_sock, _rx_targets(),
             self._timeout,
         )
-        return out[0]
+        return out[0], rvotes[0]
 
     @staticmethod
     def _chunk_bounds(total: int, n: int, c: int) -> "tuple[int, int]":
@@ -1192,11 +1236,13 @@ class _Lane:
             if r == p.root:
                 iov = _array_frame_iovecs(p.arrays)
                 _sendmsg_all(self._next_sock, [
-                    hdr.pack(_OP_BROADCAST, self._seq, 0, _iov_nbytes(iov)),
+                    hdr.pack(
+                        _OP_BROADCAST, self._seq, 0, _iov_nbytes(iov), 0
+                    ),
                     *iov,
                 ])
                 return [np.array(a, copy=True) for a in p.arrays]
-            r_op, r_seq, _, r_len = hdr.unpack(
+            r_op, r_seq, _, r_len, _ = hdr.unpack(
                 self._bufs.recv_header(self._prev_sock, hdr.size)
             )
             if (r_op, r_seq) != (_OP_BROADCAST, self._seq):
@@ -1209,7 +1255,7 @@ class _Lane:
                 # store-and-forward: the send completes before the pool
                 # slot can be reused, so the view is forwarded verbatim
                 _sendmsg_all(self._next_sock, [
-                    hdr.pack(_OP_BROADCAST, self._seq, 0, r_len),
+                    hdr.pack(_OP_BROADCAST, self._seq, 0, r_len, 0),
                     payload,
                 ])
             return _unpack_arrays(payload)
@@ -1221,7 +1267,7 @@ class _Lane:
             carry_len = _iov_nbytes(carry)
             for step in range(n - 1):
                 src = (r - step - 1) % n
-                data = self._ring_sendrecv(
+                data, _ = self._ring_sendrecv(
                     _OP_ALLGATHER, step, carry, carry_len
                 )
                 gathered[src] = _unpack_arrays(data)
@@ -1264,7 +1310,7 @@ class _Lane:
 
     def _ring_reduce_scatter_phase(self, p: _PendingOp,
                                    flats: Sequence[np.ndarray],
-                                   reduce_fn) -> None:
+                                   reduce_fn, vote: int) -> int:
         """THE reduce-scatter phase, shared verbatim by ALLREDUCE and
         REDUCE_SCATTER (the hoist the ISSUE's satellite asks for): n-1
         hops, each moving ~1/n of the lane's payload; after step s, part
@@ -1282,20 +1328,24 @@ class _Lane:
         for step in range(n - 1):
             send_views = self._part_views(flats, n, (r - step) % n)
             recv_views = self._part_views(flats, n, (r - step - 1) % n)
-            data = self._ring_sendrecv(
+            data, rvote = self._ring_sendrecv(
                 p.opcode, step,
                 rs_codec.encode_iovecs(send_views),
                 self._expect_len(rs_codec, send_views),
+                vote=vote,
             )
+            vote |= rvote
             if len(data) != self._expect_len(rs_codec, recv_views):
                 raise ConnectionError(
                     "ring allreduce chunk size mismatch (divergent shapes?)"
                 )
             rs_codec.decode_into(data, recv_views, reduce_fn)
+        return vote
 
     def _ring_allgather_phase(self, p: _PendingOp,
                               flats: Sequence[np.ndarray],
-                              owned: "Optional[List[bool]]") -> None:
+                              owned: "Optional[List[bool]]",
+                              vote: int) -> int:
         """All-gather of the completed parts. Each part is encoded ONCE
         by its owner and the received bytes are forwarded VERBATIM, so
         with a lossy codec every rank decodes identical bytes — replicas
@@ -1324,15 +1374,17 @@ class _Lane:
         carry_len = self._expect_len(codec, own_views)
         for step in range(n - 1):
             recv_views = self._part_views(flats, n, (r - step) % n)
-            data = self._ring_sendrecv(
-                p.opcode, n - 1 + step, carry, carry_len
+            data, rvote = self._ring_sendrecv(
+                p.opcode, n - 1 + step, carry, carry_len, vote=vote
             )
+            vote |= rvote
             if len(data) != self._expect_len(codec, recv_views):
                 raise ConnectionError(
                     "ring allreduce chunk size mismatch (divergent shapes?)"
                 )
             self._decode_filtered(codec, data, recv_views, owned, copy)
             carry, carry_len = [data], len(data)
+        return vote
 
     def _ring_allreduce_chunks(self, p: _PendingOp) -> None:
         """Bandwidth-optimal allreduce (or reduce_scatter) over this
@@ -1356,8 +1408,10 @@ class _Lane:
         owned: "Optional[List[bool]]" = None
         if p.opcode == _OP_REDUCE_SCATTER:
             owned = [o == self._rank for o in p.owners]
-        self._ring_reduce_scatter_phase(p, flats, reduce_fn)
-        self._ring_allgather_phase(p, flats, owned)
+        vote = self._ctx._vote_health_bit()
+        vote = self._ring_reduce_scatter_phase(p, flats, reduce_fn, vote)
+        vote = self._ring_allgather_phase(p, flats, owned, vote)
+        self._ctx._record_vote(vote)
         if p.op == ReduceOp.AVG:
             for i, f in enumerate(flats):
                 if owned is None or owned[i]:
@@ -1514,6 +1568,13 @@ class TcpCommContext(CommContext):
         self._listener: Optional[socket.socket] = None
         self._error: Optional[Exception] = None
         self._op_delay = 0.0  # test hook: simulated per-op wire latency
+        # Data-plane commit votes (set_vote_health / take_commit_vote):
+        # windowed aggregate of the health bytes that rode this
+        # context's gradient collectives since the last take.
+        self._vote_health = None
+        self._vote_lock = threading.Lock()
+        self._vote_ops = 0
+        self._vote_unhealthy = False
         # Per-lane phase timers (comm_submit_wire / comm_wire_reduce /
         # comm_reduce_future + comm_l{i}_wire_reduce). The Manager shares
         # its own Metrics in via set_metrics so bench surfaces both.
@@ -1570,6 +1631,11 @@ class TcpCommContext(CommContext):
             self._world_size = world_size
             self._error = None
             self._rr = 0
+        with self._vote_lock:
+            # votes from a previous membership describe a wire that no
+            # longer exists — never let them commit a step on this one
+            self._vote_ops = 0
+            self._vote_unhealthy = False
 
         n_lanes = 1 if world_size == 1 else self._channels
         lanes = [_Lane(self, i) for i in range(n_lanes)]
@@ -2000,6 +2066,54 @@ class TcpCommContext(CommContext):
                 ev.emit(
                     "error_latched", source="host", error=repr(e)[:200]
                 )
+
+    # ------------------------------------------- data-plane commit votes
+    # The 1-byte health votes riding the gradient opcodes (see the star
+    # frame comment above _star_allreduce_root_chunks and _RING_HDR). A
+    # voted op proves, with step-fresh evidence carried by the step's own
+    # collective, that every wire participant completed the op and
+    # reported healthy — the Manager's zero-RPC should_commit substrate.
+
+    def set_vote_health(self, fn) -> None:
+        """Install the local health provider (``fn() -> bool``, True =
+        healthy) sampled when each gradient op ships its vote byte. The
+        Manager wires its error-latch state here; default (None) votes
+        healthy unless this context itself has latched an error."""
+        self._vote_health = fn
+
+    def _vote_health_bit(self) -> int:
+        """This rank's vote byte: 1 = unhealthy. A latched transport
+        error always votes unhealthy regardless of the provider; a
+        provider that raises is itself evidence of trouble."""
+        if self.errored() is not None:
+            return 1
+        fn = self._vote_health
+        if fn is None:
+            return 0
+        try:
+            return 0 if fn() else 1
+        except Exception:  # noqa: BLE001 — a broken provider is unhealthy
+            return 1
+
+    def _record_vote(self, bit: int) -> None:
+        with self._vote_lock:
+            self._vote_ops += 1
+            if bit & 1:
+                self._vote_unhealthy = True
+
+    def take_commit_vote(self) -> "Optional[bool]":
+        """Aggregate of the votes recorded since the last call: True
+        (>= 1 voted op, all participants healthy on every one), False
+        (any dissent), None (no voted op completed in the window — e.g.
+        the hier topology, whose three-phase composition rides child
+        contexts: vote ABSENT, caller must run the full barrier)."""
+        with self._vote_lock:
+            ops, bad = self._vote_ops, self._vote_unhealthy
+            self._vote_ops = 0
+            self._vote_unhealthy = False
+        if ops == 0:
+            return None
+        return not bad
 
     # ------------------------------------------------- wire introspection
     # (CommContext API; the DDP error-feedback arena keys off these.)

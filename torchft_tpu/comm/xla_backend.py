@@ -1614,12 +1614,13 @@ def _host_allreduce(contribs: List[List[np.ndarray]], algorithm: str,
 
 class _Sub:
     __slots__ = ("opcode", "arrays", "op", "root", "fut", "owners",
-                 "topology", "t_submit")
+                 "topology", "vote", "t_submit")
 
     def __init__(self, opcode: str, arrays: List[np.ndarray], op: str,
                  root: int, fut: Future,
                  owners: "Optional[List[int]]" = None,
-                 topology: "Optional[str]" = None) -> None:
+                 topology: "Optional[str]" = None,
+                 vote: int = 0) -> None:
         self.opcode = opcode
         self.arrays = arrays
         self.op = op
@@ -1628,6 +1629,9 @@ class _Sub:
         self.owners = owners  # reduce_scatter: destination rank per array
         # allreduce: per-op topology override (None = context default)
         self.topology = topology
+        # this rank's commit-vote health bit (1 = unhealthy), sampled at
+        # submit; gradient opcodes only (0 elsewhere)
+        self.vote = vote
         self.t_submit = time.perf_counter()
 
 
@@ -1912,6 +1916,19 @@ class _XlaGroup:
             for sub, m in zip(ordered, sinks):
                 m.observe("comm_submit_wire", t_exec - sub.t_submit)
             self._execute_allreduce(ordered)
+            # Commit vote: the group rendezvous already gathered every
+            # rank's health bit with the op, so the aggregate is an OR
+            # folded HERE — the single-process lowering of the 1-element
+            # error-bit psum (a real SPMD launch would append the bit to
+            # the executable's psum; the rendezvous IS the collective on
+            # this plane, module docstring). An expired/failed op records
+            # nothing: vote absent, the Manager falls back to the full
+            # barrier.
+            agg = 0
+            for sub in ordered:
+                agg |= sub.vote & 1
+            for r in range(n):
+                self._members[r]._record_vote(agg)
             # Spans observed BEFORE the futures resolve: a caller that
             # snapshots metrics right after .result() must see them
             # (the smoke gate does exactly that).
@@ -2335,6 +2352,12 @@ class XlaCommContext(CommContext):
         self._generation = 0
         self._error: Optional[Exception] = None
         self._lock = threading.Lock()
+        # Data-plane commit votes (set_vote_health / take_commit_vote):
+        # same window semantics as TcpCommContext's.
+        self._vote_health = None
+        self._vote_lock = threading.Lock()
+        self._vote_ops = 0
+        self._vote_unhealthy = False
         self.metrics = Metrics()
         self.metrics.label("comm_backend", self.backend_name)
         self._events = None  # flight recorder (set_events)
@@ -2470,6 +2493,11 @@ class XlaCommContext(CommContext):
             self._error = None
             self._seq = 0
             generation = self._generation
+        with self._vote_lock:
+            # votes from a previous membership describe a cohort that no
+            # longer exists — never let them commit a step on this one
+            self._vote_ops = 0
+            self._vote_unhealthy = False
         ev = self._events
         if world_size == 1:
             if ev:
@@ -2565,6 +2593,46 @@ class XlaCommContext(CommContext):
         if ev:
             ev.emit("error_latched", source="xla", error=repr(e)[:200])
 
+    # ------------------------------------------- data-plane commit votes
+    # Same surface and window semantics as TcpCommContext's: a voted op
+    # proves every cohort member reached the step's collective and
+    # reported healthy. On this plane the evidence is the group
+    # rendezvous itself — see the vote fold in _XlaGroup._execute.
+
+    def set_vote_health(self, fn) -> None:
+        """Install the local health provider (``fn() -> bool``, True =
+        healthy) sampled when each gradient op is submitted."""
+        self._vote_health = fn
+
+    def _vote_health_bit(self) -> int:
+        if self.errored() is not None:
+            return 1
+        fn = self._vote_health
+        if fn is None:
+            return 0
+        try:
+            return 0 if fn() else 1
+        except Exception:  # noqa: BLE001 — a broken provider is unhealthy
+            return 1
+
+    def _record_vote(self, bit: int) -> None:
+        with self._vote_lock:
+            self._vote_ops += 1
+            if bit & 1:
+                self._vote_unhealthy = True
+
+    def take_commit_vote(self) -> "Optional[bool]":
+        """Aggregate of the votes since the last call: True (>= 1 voted
+        op, all healthy), False (any dissent), None (no voted op — the
+        caller must run the full commit barrier)."""
+        with self._vote_lock:
+            ops, bad = self._vote_ops, self._vote_unhealthy
+            self._vote_ops = 0
+            self._vote_unhealthy = False
+        if ops == 0:
+            return None
+        return not bad
+
     # ------------------------------------------------- wire introspection
 
     def wire_codec_name(self) -> str:
@@ -2648,7 +2716,12 @@ class XlaCommContext(CommContext):
                 return Work(fut)
             self._seq += 1
             seq = self._seq
+        grad_op = opcode in ("allreduce", "reduce_scatter")
         if world == 1:
+            if grad_op:
+                # solo: the op's vote is this rank's own health (same
+                # degenerate evidence as the host transport's solo wire)
+                self._record_vote(self._vote_health_bit())
             if opcode == "allgather":
                 fut.set_result([prepared])
             else:
@@ -2662,6 +2735,7 @@ class XlaCommContext(CommContext):
                 opcode, prepared, op, root, fut,
                 owners=None if owners is None else [int(o) for o in owners],
                 topology=topology,
+                vote=self._vote_health_bit() if grad_op else 0,
             ),
             self._timeout,
         )

@@ -70,6 +70,13 @@ class QuorumResult:
     transport_world_size: int = 0
     transport_replica_ids: List[str] = field(default_factory=list)
     heal: bool = False
+    # Epoch lease (steady-state fast path): the membership epoch this
+    # quorum was announced at and the lease duration the lighthouse
+    # grants (0 = leases disabled / pre-lease lighthouse). While an
+    # EpochWatch sees the epoch unchanged and the lease is live, the
+    # manager steps with zero control RPCs.
+    membership_epoch: int = 0
+    lease_ms: int = 0
 
     @staticmethod
     def from_json(payload: str) -> "QuorumResult":
@@ -92,6 +99,8 @@ class QuorumResult:
                 d.get("transport_replica_ids") or []
             ),
             heal=d["heal"],
+            membership_epoch=d.get("membership_epoch", 0),
+            lease_ms=d.get("lease_ms", 0),
         )
 
 
@@ -130,6 +139,7 @@ class Lighthouse:
         domain: Optional[str] = None,
         upstream_addr: Optional[str] = None,
         upstream_report_interval_ms: Optional[int] = None,
+        lease_ms: Optional[int] = None,
     ) -> None:
         host, port = _split_bind(bind)
         lib = get_lib()
@@ -147,6 +157,8 @@ class Lighthouse:
             extra["upstream_report_interval_ms"] = int(
                 upstream_report_interval_ms
             )
+        if lease_ms is not None:
+            extra["lease_ms"] = int(lease_ms)
         self._handle = lib.ft_lighthouse_new(
             host.encode(),
             port,
@@ -280,6 +292,22 @@ class ManagerClient:
         )
         check_error(err)
         return QuorumResult.from_json(take_string(ptr))
+
+    def epoch_watch(
+        self, epoch: int, timeout: "float | timedelta"
+    ) -> "tuple[int, bool]":
+        """Park on the manager's EpochWatch proxy until the membership
+        epoch moves off ``epoch`` or ~timeout elapses. Returns
+        ``(current_epoch, changed)`` — ``changed=False`` at the deadline
+        is a lease renewal; ``changed=True`` means the fleet moved and
+        any lease granted at ``epoch`` is dead."""
+        err = ctypes.c_char_p()
+        ptr = get_lib().ft_manager_client_epoch_watch(
+            self._handle, epoch, _ms(timeout), ctypes.byref(err)
+        )
+        check_error(err)
+        d = json.loads(take_string(ptr))
+        return int(d.get("epoch", 0)), bool(d.get("changed", False))
 
     def checkpoint_metadata(
         self, rank: int, timeout: "float | timedelta"

@@ -20,6 +20,8 @@ class QuorumResult:
     transport_world_size: int
     transport_replica_ids: List[str]
     heal: bool
+    membership_epoch: int
+    lease_ms: int
 
 class ManagerClient:
     def __init__(
@@ -35,6 +37,9 @@ class ManagerClient:
         data_plane: bool = ...,
         comm_epoch: int = ...,
     ) -> QuorumResult: ...
+    def epoch_watch(
+        self, epoch: int, timeout: "float | timedelta"
+    ) -> "tuple[int, bool]": ...
     def checkpoint_metadata(
         self, rank: int, timeout: "float | timedelta"
     ) -> str: ...
@@ -81,6 +86,7 @@ class Lighthouse:
         domain: Optional[str] = ...,
         upstream_addr: Optional[str] = ...,
         upstream_report_interval_ms: Optional[int] = ...,
+        lease_ms: Optional[int] = ...,
     ) -> None: ...
     def address(self) -> str: ...
     def shutdown(self) -> None: ...

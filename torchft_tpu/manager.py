@@ -427,6 +427,44 @@ class Manager:
         # assigns us a heal, cleared when the healed state is applied
         self._heal_t0: Optional[float] = None
 
+        # --- steady-state fast path (epoch lease + data-plane votes) ------
+        # While a lease is live (granted by the last full quorum, renewed
+        # by the parked EpochWatch long-poll, broken by any epoch bump /
+        # latch edge / expiry), start_quorum is a local check and
+        # should_commit rides the 1-byte health vote folded into the
+        # step's own collective — zero control-plane RPCs per step. The
+        # fast path is restricted to world_size == 1 (single local rank):
+        # the ManagerServer's quorum/commit fan-in across local ranks is
+        # itself a control RPC per rank, so a multi-rank group always
+        # takes the full path. TORCHFT_TPU_FASTPATH=0 (or BENCH_FASTPATH=0
+        # via the bench) disables it entirely — the A/B lever.
+        self._lease_enabled = (
+            os.environ.get("TORCHFT_TPU_FASTPATH", "1") not in ("0", "false")
+            and self._world_size == 1
+            and self._data_plane  # observers never step fast: their vote
+            # rides a private 1-member wire that proves nothing
+        )
+        self._lease_lock = threading.Lock()
+        self._lease_epoch: Optional[int] = None
+        self._lease_ms = 0
+        self._lease_deadline = 0.0  # monotonic
+        self._lease_live = False
+        self._lease_thread: Optional[threading.Thread] = None
+        self._lease_stop = threading.Event()
+        # Armed by a fastpath start_quorum, consumed by the next
+        # should_commit; never survives across steps.
+        self._fastpath_active = False
+        # Control RPCs issued for the CURRENT step (quorum + barrier);
+        # gauged as control_rpcs_per_step — the counter the bench pins at
+        # exactly 0 on the fastpath arm.
+        self._control_rpcs = 0
+        self.metrics.gauge("control_rpcs_per_step", 0.0)
+        # Health provider for the wire vote: the transport samples this
+        # when it stamps the vote bit onto the step's collective frames.
+        set_vote_health = getattr(comm, "set_vote_health", None)
+        if callable(set_vote_health):
+            set_vote_health(lambda: self.errored() is None)
+
     # ------------------------------------------------------------- lifecycle
 
     def set_state_dict_fns(
@@ -437,6 +475,12 @@ class Manager:
 
     def shutdown(self, wait: bool = True) -> None:
         """Shutdown the manager server, checkpoint transport and comm."""
+        # Stop the epoch-watch loop first: a parked EpochWatch against our
+        # own ManagerServer would otherwise error (and log) when the
+        # server goes down mid-poll.
+        self._lease_stop.set()
+        with self._lease_lock:
+            self._lease_live = False
         self._checkpoint_transport.shutdown(wait=wait)
         if self._manager is not None:
             self._manager.shutdown()
@@ -685,6 +729,12 @@ class Manager:
             # group's lighthouse (domain aggregator or root); None on
             # ranks that don't own the ManagerServer
             "lighthouse_addr": self._lighthouse_addr,
+            # steady-state fast path: live lease + epoch it covers, and
+            # the control-RPC count of the current step (0 on a fastpath
+            # step) — fleet_top's lease / rpc columns read these.
+            "lease_live": self._lease_valid(),
+            "lease_epoch": self._lease_epoch,
+            "control_rpcs_per_step": self._control_rpcs,
         }
 
     # ---------------------------------------------------------- error model
@@ -731,6 +781,104 @@ class Manager:
         self._pending_work.append(out)
         return out
 
+    # ------------------------------------------------------- epoch lease
+
+    def _lease_valid(self) -> bool:
+        import time as _time
+
+        with self._lease_lock:
+            return (
+                self._lease_live
+                and self._lease_epoch is not None
+                and _time.monotonic() < self._lease_deadline
+            )
+
+    def _grant_lease(self, epoch: int, lease_ms: int) -> None:
+        """Arm (or re-arm) the lease from a full quorum's announcement and
+        make sure the EpochWatch renewal thread is running."""
+        import time as _time
+
+        with self._lease_lock:
+            self._lease_epoch = epoch
+            self._lease_ms = lease_ms
+            self._lease_deadline = _time.monotonic() + lease_ms / 1000.0
+            self._lease_live = True
+            self.metrics.incr("lease_grants")
+            start_thread = (
+                self._lease_thread is None
+                or not self._lease_thread.is_alive()
+            )
+            if start_thread:
+                self._lease_thread = threading.Thread(
+                    target=self._epoch_watch_loop,
+                    name="epoch_watch",
+                    daemon=True,
+                )
+                self._lease_thread.start()
+
+    def _break_lease(self, reason: str, epoch: Optional[int] = None) -> None:
+        """Invalidate the lease (idempotent). ``epoch`` guards the watch
+        thread against breaking a FRESHER lease than the one it watched:
+        a full quorum may re-grant while the watcher is parked on the old
+        epoch, and its (correct) changed=True answer must not kill the
+        new lease."""
+        with self._lease_lock:
+            if not self._lease_live:
+                return
+            if epoch is not None and self._lease_epoch != epoch:
+                return
+            self._lease_live = False
+            broken_epoch = self._lease_epoch
+        self.metrics.incr("lease_breaks")
+        ev = self.events
+        if ev:
+            ev.emit(
+                "lease_break", step=self._step, epoch=self._quorum_epoch,
+                lease_epoch=broken_epoch, reason=reason,
+            )
+        self._logger.info(
+            f"lease broken ({reason}) lease_epoch={broken_epoch}"
+        )
+
+    def _epoch_watch_loop(self) -> None:
+        """Renew the lease OFF the step path: park an EpochWatch long-poll
+        on the lighthouse (proxied by our ManagerServer). Unchanged epoch
+        at wake ⇒ the membership the lease describes still stands ⇒
+        re-stamp the deadline. Any change, error, or shutdown breaks the
+        lease and exits; the next full quorum's grant restarts the
+        thread. The step path never blocks on this loop — it only reads
+        (_lease_valid)."""
+        import time as _time
+
+        while not self._lease_stop.is_set():
+            with self._lease_lock:
+                live = self._lease_live
+                epoch = self._lease_epoch
+                lease_s = self._lease_ms / 1000.0
+            if not live or epoch is None:
+                return
+            # Poll at half the lease duration: one successful renewal
+            # always lands before the previous stamp expires.
+            try:
+                _new_epoch, changed = self._client.epoch_watch(
+                    epoch, timeout=max(0.05, lease_s / 2.0)
+                )
+            except Exception as e:  # noqa: BLE001 — any watch failure
+                # (manager down, lighthouse unreachable, timeout) is an
+                # absent liveness signal: break toward the full path.
+                self._break_lease(f"watch_error: {e!r}", epoch=epoch)
+                return
+            if changed:
+                self._break_lease("epoch_advanced", epoch=epoch)
+                return
+            with self._lease_lock:
+                if self._lease_live and self._lease_epoch == epoch:
+                    self._lease_deadline = _time.monotonic() + lease_s
+
+    def _count_control_rpc(self) -> None:
+        self._control_rpcs += 1
+        self.metrics.gauge("control_rpcs_per_step", float(self._control_rpcs))
+
     # --------------------------------------------------------------- quorum
 
     def start_quorum(
@@ -755,6 +903,37 @@ class Manager:
                 self._logger.exception(  # about to supersede it
                     f"previous quorum failed, starting fresh: {e}"
                 )
+
+        # --- steady-state fast path ---------------------------------------
+        # Lease live + watched epoch unchanged + no latch edge: the last
+        # full quorum's membership, participation and configured transport
+        # all still describe this fleet, so start_quorum is a LOCAL check
+        # — no RPC. Every invalidation edge (epoch bump from the watcher,
+        # either latch, lease expiry, an explicit shrink, a pending heal)
+        # falls through to the full Quorum path below, which is also the
+        # heal/reconfigure path, unchanged.
+        self._fastpath_active = False
+        self._control_rpcs = 0
+        self.metrics.gauge("control_rpcs_per_step", 0.0)
+        if self._lease_enabled and not shrink_only:
+            latched = (
+                self.errored() is not None
+                or self._comm.errored() is not None
+            )
+            if latched:
+                # A latch is evidence the fleet the lease describes is
+                # gone (wire fault or step error) — break toward full.
+                self._break_lease("latch_edge")
+            elif (
+                not self._healing
+                and self._transport_key is not None
+                and self._lease_valid()
+            ):
+                fast_fut: Future = Future()
+                fast_fut.set_result(None)
+                self._quorum_future = fast_fut
+                self._fastpath_active = True
+                return
 
         with self._errored_lock:
             self._errored = None
@@ -831,6 +1010,7 @@ class Manager:
         self._finish_quorum(quorum, allow_heal)
 
     def _quorum_rpc(self, allow_heal, shrink_only, quorum_timeout):
+        self._count_control_rpc()
         return self._client.quorum(
             rank=self._rank,
             step=self._step,
@@ -1068,6 +1248,23 @@ class Manager:
                     self._pending_state_dict = None
                     self.report_error(e)
 
+        # --- lease grant --------------------------------------------------
+        # A clean full quorum arms (or re-arms) the lease for the epoch it
+        # announced. Never grant off a latched step (the configure above
+        # failed — the transport does NOT match this membership) and never
+        # grant while healing (we are behind the cohort until the pending
+        # state applies; the post-heal quorum grants instead).
+        lease_ms = getattr(quorum, "lease_ms", 0) or 0
+        membership_epoch = getattr(quorum, "membership_epoch", -1)
+        if (
+            self._lease_enabled
+            and lease_ms > 0
+            and membership_epoch >= 0
+            and not self._healing
+            and self.errored() is None
+        ):
+            self._grant_lease(membership_epoch, lease_ms)
+
     def _apply_pending_state_dict(self) -> None:
         assert self._healing, "must be in healing state"
         assert self._quorum_future is not None, (
@@ -1148,8 +1345,60 @@ class Manager:
         local_should_commit = enough_replicas and self.errored() is None
         import time as _time
 
+        # --- steady-state fast path ---------------------------------------
+        # Armed by this step's local start_quorum, consumed exactly once.
+        # The commit rides the 1-byte health vote the transport folded
+        # into the step's own collective: commit WITHOUT the barrier RPC
+        # only when our local ballot is True, every wire member voted
+        # healthy (take_commit_vote() is True — absent votes return None),
+        # AND the lease is still valid at this instant. That is never
+        # weaker evidence than the full path: with world_size == 1 the
+        # barrier's AND over local ranks IS the local ballot, and the wire
+        # vote adds peer health on top. Any dissent, absent vote, latch,
+        # or lease edge breaks the lease and re-runs the full barrier —
+        # whose discard bookkeeping is the single source of truth.
+        fastpath = self._fastpath_active
+        self._fastpath_active = False
+        if fastpath:
+            take_vote = getattr(self._comm, "take_commit_vote", None)
+            wire_vote = take_vote() if callable(take_vote) else None
+            if (
+                local_should_commit
+                and wire_vote is True
+                and self._lease_valid()
+            ):
+                self.metrics.incr("fastpath_steps")
+                self.metrics.incr("steps_committed")
+                ev = self.events
+                if ev:
+                    ev.emit(
+                        "step_commit", step=self._step,
+                        epoch=self._quorum_epoch,
+                        participants=self.num_participants(),
+                        fastpath=True,
+                    )
+                self._checkpoint_transport.disallow_checkpoint()
+                self._step += 1
+                self._batches_committed += self.num_participants()
+                fast_fut: Future = Future()
+                fast_fut.set_result(True)
+                fast_fut.local_should_commit = True  # type: ignore[attr-defined]
+                return fast_fut
+            if wire_vote is False:
+                reason = "vote_dissent"
+            elif wire_vote is None:
+                reason = "vote_absent"
+            elif not local_should_commit:
+                reason = "local_vote_false"
+            else:
+                reason = "lease_expired"
+            self._break_lease(reason)
+        if self._lease_enabled:
+            self.metrics.incr("fallback_steps")
+
         def _barrier() -> bool:
             commit_start = _time.perf_counter()
+            self._count_control_rpc()
             should_commit = self._client.should_commit(
                 self._rank,
                 self._step,

@@ -122,6 +122,7 @@ EVENT_KINDS = (
     "microbatch_send",
     "microbatch_recv",
     "stage_rebalance",
+    "lease_break",
 )
 
 _DEFAULT_CAPACITY = 4096
