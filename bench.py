@@ -1418,6 +1418,10 @@ def _child_main() -> None:
         quorum_timeout=60.0,
         connect_timeout=60.0,
         data_plane=not observer,
+        # BENCH_JOB_ID homes this bench onto one tenant of a shared
+        # (multi-job) lighthouse; default keeps the single-tenant wire
+        # shape byte-identical.
+        job_id=os.environ.get("BENCH_JOB_ID", "default"),
     )
     ddp = DistributedDataParallel(
         manager, bucket_bytes=_bench_bucket_bytes(),

@@ -84,7 +84,8 @@ void ft_free(char* p) { free(p); }
 // so the ABI stays stable as options grow:
 //   {"cache_quorum": bool, "prune_after_ms": int, "tier": int,
 //    "domain": str, "upstream_addr": str,
-//    "upstream_report_interval_ms": int, "lease_ms": int}
+//    "upstream_report_interval_ms": int, "lease_ms": int,
+//    "fleet_capacity": int}
 // NULL or "" keeps every default (cached decisions, root tier).
 void* ft_lighthouse_new(const char* bind_host, int port, const char* hostname,
                         uint64_t min_replicas, uint64_t join_timeout_ms,
@@ -109,6 +110,7 @@ void* ft_lighthouse_new(const char* bind_host, int port, const char* hostname,
       opts.upstream_report_interval_ms = static_cast<uint64_t>(
           extra.get_int("upstream_report_interval_ms", 500));
       opts.lease_ms = extra.get_int("lease_ms", 0);
+      opts.fleet_capacity = extra.get_int("fleet_capacity", 0);
     }
     auto lh = std::make_unique<ftlighthouse::Lighthouse>(std::move(opts));
     lh->start();
@@ -133,16 +135,23 @@ void ft_lighthouse_free(void* handle) {
 
 // ------------------------------------------------------------------- manager
 
+// `extra_json` (optional, NULL/"" = defaults) carries growth options:
+//   {"job_id": str}  — multi-tenant job this replica group belongs to.
 void* ft_manager_new(const char* replica_id, const char* lighthouse_addr,
                      const char* hostname, const char* bind_host, int port,
                      const char* store_addr, uint64_t world_size,
                      uint64_t heartbeat_interval_ms,
                      uint64_t connect_timeout_ms, int exit_on_kill,
-                     char** err) {
+                     const char* extra_json, char** err) {
   try {
     ftmanager::ManagerOpts opts;
     opts.replica_id = replica_id;
     opts.lighthouse_addr = lighthouse_addr;
+    if (extra_json != nullptr && extra_json[0] != '\0') {
+      auto extra = ftjson::Value::parse(extra_json);
+      std::string job = extra.get_str("job_id", "default");
+      opts.job_id = job.empty() ? "default" : job;
+    }
     opts.hostname = hostname ? hostname : "127.0.0.1";
     opts.bind_host = bind_host ? bind_host : "0.0.0.0";
     opts.port = port;
@@ -325,16 +334,19 @@ void ft_lighthouse_client_free(void* handle) {
   delete static_cast<ClientHandle*>(handle);
 }
 
-// `ids_json`: either a JSON string ("replica_0") for the single-id form
-// or a JSON array (["a","b",...]) for one batched RPC carrying a whole
-// domain's heartbeats.
+// `ids_json`: a JSON string ("replica_0") for the single-id form, a JSON
+// array (["a","b",...]) for one batched RPC carrying a whole domain's
+// heartbeats, or a JSON object passed through as the full request body
+// (the multi-tenant form: {"replica_id": ..., "job_id": ...}).
 int ft_lighthouse_client_heartbeat2(void* handle, const char* ids_json,
                                     uint64_t timeout_ms, char** err) {
   auto* c = static_cast<ClientHandle*>(handle);
   try {
     auto ids = ftjson::Value::parse(ids_json);
     ftjson::Object req;
-    if (ids.is_string()) {
+    if (ids.is_object()) {
+      req = std::move(ids.as_object());
+    } else if (ids.is_string()) {
       req["replica_id"] = ids.as_str();
     } else {
       req["replica_ids"] = std::move(ids);
@@ -355,8 +367,15 @@ char* ft_lighthouse_client_quorum2(void* handle, const char* requester_json,
                                    uint64_t timeout_ms, char** err) {
   auto* c = static_cast<ClientHandle*>(handle);
   try {
+    auto parsed = ftjson::Value::parse(requester_json);
     ftjson::Object req;
-    req["requester"] = ftjson::Value::parse(requester_json);
+    if (parsed.is_object() && parsed.has("requester")) {
+      // Full-body passthrough (the multi-tenant form: the caller already
+      // wrapped the member and added job_id / registration fields).
+      req = std::move(parsed.as_object());
+    } else {
+      req["requester"] = std::move(parsed);
+    }
     std::string out;
     if (!client_post(c, "/torchft.LighthouseService/Quorum",
                      ftjson::Value(req).dump(),
@@ -368,6 +387,23 @@ char* ft_lighthouse_client_quorum2(void* handle, const char* requester_json,
     set_err(err, e.what());
     return nullptr;
   }
+}
+
+// Generic POST against the lighthouse: `path` is the RPC path (e.g.
+// "/torchft.LighthouseService/RegisterJob") and `body_json` the raw
+// request body. Returns the malloc'd response body. This is how Python
+// reaches RPCs that have no bespoke wrapper (RegisterJob, raw
+// EpochWatch) without an ABI bump per endpoint.
+char* ft_lighthouse_client_post(void* handle, const char* path,
+                                const char* body_json, uint64_t timeout_ms,
+                                char** err) {
+  auto* c = static_cast<ClientHandle*>(handle);
+  std::string out;
+  if (!client_post(c, path, body_json ? body_json : "{}",
+                   static_cast<int64_t>(timeout_ms), &out, err)) {
+    return nullptr;
+  }
+  return dup_string(out);
 }
 
 int ft_lighthouse_client_heartbeat(const char* lighthouse_addr,

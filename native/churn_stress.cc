@@ -61,6 +61,12 @@ std::string quorum_body(const std::string& id, int64_t step) {
   return "{\"requester\":" + mk_member(id, step).to_json().dump() + "}";
 }
 
+std::string quorum_body_job(const std::string& id, int64_t step,
+                            const std::string& job) {
+  return "{\"requester\":" + mk_member(id, step).to_json().dump() +
+         ",\"job_id\":\"" + job + "\"}";
+}
+
 // ------------------------------------------------------------- phase 1
 
 void phase1_incremental_quorum(int64_t phase_ms) {
@@ -275,6 +281,171 @@ void phase2_lighthouse_storm(int64_t phase_ms) {
       (unsigned long long)g_status_polls.load());
 }
 
+// ------------------------------------------------------------- phase 3
+
+void phase3_multijob_storm(int64_t phase_ms) {
+  // Cross-job storm (PR 19): one lighthouse, several job shards, every
+  // multi-tenant handler path racing at once — job-tagged quorum
+  // long-polls, RegisterJob (including the budget-raise re-admission
+  // that clears evictions) racing the preemption scan, per-job
+  // EpochWatch parks being broken by their own job's churn, a
+  // rate-limited job drawing 429s, and status renders walking the whole
+  // jobs_ map while shards mutate.
+  ftlighthouse::LighthouseOpts lo;
+  lo.bind_host = "127.0.0.1";
+  lo.hostname = "127.0.0.1";
+  lo.quorum.min_replicas = 2;
+  lo.quorum.join_timeout_ms = 150;
+  lo.quorum.quorum_tick_ms = 10;
+  lo.quorum.heartbeat_timeout_ms = 120;
+  lo.prune_after_ms = 400;
+  lo.fleet_capacity = 4;  // tight: the gamma claimant below preempts
+  auto lh_p = std::make_unique<ftlighthouse::Lighthouse>(lo);
+  ftlighthouse::Lighthouse& lh = *lh_p;
+  lh.start();
+
+  const std::string host = "127.0.0.1";
+  const int port = lh.port();
+  std::vector<std::thread> ts;
+
+  auto register_job = [&](const std::string& job, int64_t prio,
+                          int64_t budget, int64_t rpc_budget) {
+    (void)fthttp::http_post(
+        host, port, "/torchft.LighthouseService/RegisterJob",
+        "{\"job_id\":\"" + job + "\",\"priority\":" +
+            std::to_string(prio) + ",\"group_budget\":" +
+            std::to_string(budget) + ",\"rpc_budget\":" +
+            std::to_string(rpc_budget) + "}",
+        fthttp::now_ms() + 500);
+  };
+  register_job("alpha", 0, 1, 0);
+  register_job("beta", 5, 0, 0);
+  register_job("rl", 0, 0, 5);
+
+  // Stable members per job, long-polling quorum under their own shard.
+  for (const char* job : {"alpha", "beta"}) {
+    for (int i = 0; i < 2; i++) {
+      ts.emplace_back([&, job, i] {
+        uint64_t step = 0;
+        while (!g_stop.load(std::memory_order_relaxed)) {
+          auto r = fthttp::http_post(
+              host, port, "/torchft.LighthouseService/Quorum",
+              quorum_body_job(std::string(job) + "-" + std::to_string(i),
+                              static_cast<int64_t>(step++), job),
+              fthttp::now_ms() + 900);
+          (r.status == 200 ? g_quorum_ok : g_quorum_err)
+              .fetch_add(1, std::memory_order_relaxed);
+        }
+      });
+    }
+  }
+  // Churner in alpha: fresh ids join and walk away — per-shard expiry
+  // and prune edges, and over-budget fodder for the preemption scan.
+  ts.emplace_back([&] {
+    uint64_t gen = 0;
+    while (!g_stop.load(std::memory_order_relaxed)) {
+      auto r = fthttp::http_post(
+          host, port, "/torchft.LighthouseService/Quorum",
+          quorum_body_job("alpha-churn-" + std::to_string(gen++), 0,
+                          "alpha"),
+          fthttp::now_ms() + 120);
+      (void)r;
+      g_abandoned.fetch_add(1, std::memory_order_relaxed);
+    }
+  });
+  // High-priority claimant: every join re-runs the preemption scan
+  // against whatever the other jobs look like at that instant.
+  ts.emplace_back([&] {
+    uint64_t step = 0;
+    while (!g_stop.load(std::memory_order_relaxed)) {
+      auto r = fthttp::http_post(
+          host, port, "/torchft.LighthouseService/Quorum",
+          quorum_body_job("gamma-0", static_cast<int64_t>(step++),
+                          "gamma"),
+          fthttp::now_ms() + 300);
+      (void)r;
+    }
+  });
+  register_job("gamma", 10, 0, 0);
+  // Re-admission racer: re-registering alpha with a raised budget
+  // clears its evicted set WHILE the claimant above re-evicts.
+  ts.emplace_back([&] {
+    int64_t budget = 1;
+    while (!g_stop.load(std::memory_order_relaxed)) {
+      register_job("alpha", 0, (budget++ % 3) + 1, 0);
+      std::this_thread::sleep_for(std::chrono::milliseconds(7));
+    }
+  });
+  // Per-job EpochWatch parks: broken by the job's own churn, renewed
+  // (changed=false) when its shard sat still — both racing the tick.
+  for (const char* job : {"alpha", "beta"}) {
+    ts.emplace_back([&, job] {
+      while (!g_stop.load(std::memory_order_relaxed)) {
+        auto r = fthttp::http_post(
+            host, port, "/torchft.LighthouseService/EpochWatch",
+            "{\"replica_id\":\"" + std::string(job) +
+                "-0\",\"epoch\":0,\"job_id\":\"" + job + "\"}",
+            fthttp::now_ms() + 150);
+        (void)r;
+      }
+    });
+  }
+  // Rate-limited job: heartbeat storm far over its 5 rpc/s budget —
+  // the 429 path and drop counter race the window roll-over.
+  ts.emplace_back([&] {
+    uint64_t n = 0;
+    while (!g_stop.load(std::memory_order_relaxed)) {
+      auto r = fthttp::http_post(
+          host, port, "/torchft.LighthouseService/Heartbeat",
+          "{\"replica_id\":\"rl-" + std::to_string(n++ % 3) +
+              "\",\"job_id\":\"rl\"}",
+          fthttp::now_ms() + 200);
+      if (r.status == 200) {
+        g_heartbeats.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+  });
+  // Job-tagged batched heartbeats keeping beta warm.
+  ts.emplace_back([&] {
+    while (!g_stop.load(std::memory_order_relaxed)) {
+      auto r = fthttp::http_post(
+          host, port, "/torchft.LighthouseService/Heartbeat",
+          "{\"replica_ids\":[\"beta-0\",\"beta-1\"],"
+          "\"job_id\":\"beta\"}",
+          fthttp::now_ms() + 200);
+      if (r.status == 200) {
+        g_heartbeats.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+  });
+  // Status renders walk every shard while all of the above mutates.
+  for (const char* path : {"/status.json", "/status"}) {
+    ts.emplace_back([&, path] {
+      while (!g_stop.load(std::memory_order_relaxed)) {
+        auto r = fthttp::http_get(host, port, path,
+                                  fthttp::now_ms() + 200);
+        if (r.status == 200) {
+          g_status_polls.fetch_add(1, std::memory_order_relaxed);
+        }
+      }
+    });
+  }
+
+  std::this_thread::sleep_for(std::chrono::milliseconds(phase_ms));
+  g_stop.store(true);
+  for (auto& t : ts) t.join();
+  lh.shutdown();
+  g_stop.store(false);
+  std::printf(
+      "phase3: multijob storm ok (quorum ok=%llu err=%llu "
+      "abandoned=%llu heartbeats=%llu status=%llu)\n",
+      (unsigned long long)g_quorum_ok.load(),
+      (unsigned long long)g_quorum_err.load(),
+      (unsigned long long)g_abandoned.load(),
+      (unsigned long long)g_heartbeats.load(),
+      (unsigned long long)g_status_polls.load());
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -283,6 +454,7 @@ int main(int argc, char** argv) {
   if (phase_ms <= 0) phase_ms = 2500;
   phase1_incremental_quorum(phase_ms);
   phase2_lighthouse_storm(phase_ms);
+  phase3_multijob_storm(phase_ms);
   std::printf("churn_stress: clean\n");
   return 0;
 }

@@ -47,16 +47,37 @@ std::string html_escape(const std::string& s) {
   }
   return out;
 }
+
+std::string normalize_job(const std::string& job_id) {
+  return job_id.empty() ? std::string("default") : job_id;
+}
+
+// The prescriptive eviction decision: an evicted group learns its fate
+// in a quorum response body — never by watching its RPCs time out. The
+// body is shaped like a lease-less quorum reply minus the member list,
+// plus `evicted:true`; the manager surfaces it to every rank so the
+// job's survivors shrink through the redistribution planner while the
+// victim exits cleanly.
+Response eviction_response(const std::string& job_id, JobState& job) {
+  ftjson::Object o;
+  o["evicted"] = true;
+  o["job_id"] = job_id;
+  o["reason"] = std::string("evicted: preempted by higher-priority job");
+  o["membership_epoch"] = static_cast<int64_t>(job.iq.epoch());
+  o["lease_ms"] = static_cast<int64_t>(0);
+  return Response{200, "application/json", ftjson::Value(std::move(o)).dump()};
+}
 }  // namespace
 
 Lighthouse::Lighthouse(LighthouseOpts opts)
-    : opts_(std::move(opts)),
-      server_(opts_.bind_host, opts_.port),
-      iq_(opts_.quorum, opts_.cache_quorum, opts_.prune_after_ms) {
+    : opts_(std::move(opts)), server_(opts_.bind_host, opts_.port) {
   if (opts_.tier < 0) opts_.tier = opts_.upstream_addr.empty() ? 0 : 1;
   if (opts_.domain.empty() && opts_.tier > 0) {
     opts_.domain = "domain:" + std::to_string(server_.port());
   }
+  // The default shard exists from birth so pre-multi-tenant clients and
+  // status payloads never observe a jobless lighthouse.
+  jobs_.emplace("default", std::make_unique<JobState>(opts_));
   server_.set_handler([this](const Request& req) { return handle(req); });
 }
 
@@ -92,28 +113,113 @@ std::string Lighthouse::address() const {
   return "http://" + host + ":" + std::to_string(server_.port());
 }
 
-std::string Lighthouse::build_domain_report_locked(int64_t now_ms) {
-  ftjson::Object o;
-  o["domain"] = opts_.domain;
-  o["tier"] = static_cast<int64_t>(opts_.tier);
-  o["address"] = address();
-  o["healthy"] = static_cast<int64_t>(iq_.healthy_count());
-  o["participants"] =
-      static_cast<int64_t>(iq_.state().participants.size());
-  int64_t quorum_id = 0;
-  int64_t max_step = 0;
-  if (iq_.state().prev_quorum.has_value()) {
-    const auto& q = *iq_.state().prev_quorum;
-    quorum_id = q.quorum_id;
-    for (const auto& p : q.participants)
-      max_step = std::max(max_step, p.step);
+JobState& Lighthouse::job_locked(const std::string& job_id) {
+  std::string key = normalize_job(job_id);
+  auto it = jobs_.find(key);
+  if (it == jobs_.end()) {
+    it = jobs_.emplace(key, std::make_unique<JobState>(opts_)).first;
   }
-  o["quorum_id"] = quorum_id;
-  o["max_step"] = max_step;
-  o["report_interval_ms"] =
-      static_cast<int64_t>(opts_.upstream_report_interval_ms);
+  return *it->second;
+}
+
+bool Lighthouse::rate_limited_locked(JobState& job, int64_t now_ms) {
+  if (job.rpc_budget <= 0) return false;
+  if (now_ms - job.rpc_window_start_ms >= 1000) {
+    job.rpc_window_start_ms = now_ms;
+    job.rpc_window_count = 0;
+  }
+  if (job.rpc_window_count >= job.rpc_budget) {
+    job.rate_limit_drops += 1;
+    return true;
+  }
+  job.rpc_window_count += 1;
+  return false;
+}
+
+void Lighthouse::maybe_preempt_locked(const std::string& claimant_id,
+                                      JobState& claimant) {
+  if (opts_.fleet_capacity <= 0) return;
+  int64_t total = 0;
+  for (const auto& kv : jobs_) {
+    total += static_cast<int64_t>(kv.second->iq.healthy_count());
+  }
+  // Minimal preemption: evict exactly one group per capacity overrun,
+  // never below capacity, and only from jobs that are BOTH over their
+  // own group budget and strictly lower-priority than the claimant.
+  while (total > opts_.fleet_capacity) {
+    JobState* victim = nullptr;
+    std::string victim_name;
+    for (const auto& kv : jobs_) {
+      JobState* j = kv.second.get();
+      if (j == &claimant) continue;
+      if (j->priority >= claimant.priority) continue;
+      if (j->group_budget <= 0) continue;  // unlimited budget: not evictable
+      if (static_cast<int64_t>(j->iq.healthy_count()) <= j->group_budget) {
+        continue;
+      }
+      if (!victim || j->priority < victim->priority ||
+          (j->priority == victim->priority && kv.first < victim_name)) {
+        victim = j;
+        victim_name = kv.first;
+      }
+    }
+    if (!victim) return;
+    // Evict the max replica_id among the victim's healthy members: a
+    // deterministic choice both sides can reconstruct from status alone.
+    std::string evict_id;
+    for (const auto& hb : victim->iq.state().heartbeats) {
+      if (victim->iq.is_healthy(hb.first)) evict_id = hb.first;
+    }
+    if (evict_id.empty()) return;
+    victim->iq.evict(evict_id);
+    victim->evicted.insert(evict_id);
+    victim->preemptions += 1;
+    total -= 1;
+    // The epoch bump breaks the victim job's leases: parked EpochWatch
+    // waiters wake with changed=true, survivors fall back to the full
+    // Quorum path and re-form, and the evicted member's own Quorum RPC
+    // returns the prescriptive body above.
+    cv_.notify_all();
+  }
+  (void)claimant_id;
+}
+
+std::vector<std::string> Lighthouse::build_domain_reports_locked(
+    int64_t now_ms) {
+  std::vector<std::string> bodies;
+  for (const auto& kv : jobs_) {
+    const JobState& job = *kv.second;
+    // Silent shards (no members ever) would only add noise upstream.
+    if (job.iq.state().heartbeats.empty() &&
+        !job.iq.state().prev_quorum.has_value() && kv.first != "default") {
+      continue;
+    }
+    ftjson::Object o;
+    o["domain"] = kv.first == "default"
+                      ? opts_.domain
+                      : opts_.domain + "/job:" + kv.first;
+    o["tier"] = static_cast<int64_t>(opts_.tier);
+    o["address"] = address();
+    o["job_id"] = kv.first;
+    o["healthy"] = static_cast<int64_t>(job.iq.healthy_count());
+    o["participants"] =
+        static_cast<int64_t>(job.iq.state().participants.size());
+    int64_t quorum_id = 0;
+    int64_t max_step = 0;
+    if (job.iq.state().prev_quorum.has_value()) {
+      const auto& q = *job.iq.state().prev_quorum;
+      quorum_id = q.quorum_id;
+      for (const auto& p : q.participants)
+        max_step = std::max(max_step, p.step);
+    }
+    o["quorum_id"] = quorum_id;
+    o["max_step"] = max_step;
+    o["report_interval_ms"] =
+        static_cast<int64_t>(opts_.upstream_report_interval_ms);
+    bodies.push_back(ftjson::Value(std::move(o)).dump());
+  }
   (void)now_ms;
-  return ftjson::Value(std::move(o)).dump();
+  return bodies;
 }
 
 void Lighthouse::tick_loop() {
@@ -125,7 +231,9 @@ void Lighthouse::tick_loop() {
                fthttp::parse_http_addr(opts_.upstream_addr, &up_host,
                                        &up_port);
   while (!stopping_) {
-    tick_locked();
+    // One pass over every shard: a stable job's decision() is an epoch
+    // cache hit, so the per-tick cost of quiet tenants is O(1) each.
+    for (auto& kv : jobs_) tick_job_locked(*kv.second);
     // Evict domain rows silent far past their own advertised interval
     // (well after the 3x staleness flag, so operators see the STALE row
     // first): an aggregator restarting under a fresh generated domain
@@ -150,13 +258,15 @@ void Lighthouse::tick_loop() {
           static_cast<int64_t>(opts_.upstream_report_interval_ms);
       if (now - last_report_ms >= interval) {
         last_report_ms = now;
-        std::string body = build_domain_report_locked(now);
+        std::vector<std::string> bodies = build_domain_reports_locked(now);
         // Never post while holding the state lock; a slow/dead root
         // must not block heartbeats or quorum RPCs.
         lk.unlock();
-        fthttp::http_post(up_host, up_port,
-                          "/torchft.LighthouseService/DomainReport", body,
-                          fthttp::now_ms() + interval);
+        for (const auto& body : bodies) {
+          fthttp::http_post(up_host, up_port,
+                            "/torchft.LighthouseService/DomainReport", body,
+                            fthttp::now_ms() + interval);
+        }
         lk.lock();
         if (stopping_) break;
       }
@@ -166,16 +276,19 @@ void Lighthouse::tick_loop() {
   }
 }
 
-void Lighthouse::tick_locked() {
-  const auto& decision = iq_.decision(fthttp::now_ms());
-  last_reason_ = decision.reason;
-  // Epoch-watch wakeup: decision()'s sweep (expiry/prune) and any join
-  // since the last tick may have bumped the membership epoch without an
-  // announcement. Parked EpochWatch waiters key their lease validity on
-  // exactly this edge, so notify them here — detection latency is then
-  // bounded by quorum_tick_ms instead of the watch re-stamp interval.
-  if (iq_.epoch() != watched_epoch_) {
-    watched_epoch_ = iq_.epoch();
+void Lighthouse::tick_job_locked(JobState& job) {
+  const auto& decision = job.iq.decision(fthttp::now_ms());
+  job.last_reason = decision.reason;
+  // Epoch-watch wakeup: decision()'s sweep (expiry/prune), any join since
+  // the last tick, and evictions may have bumped THIS job's membership
+  // epoch without an announcement. Parked EpochWatch waiters key their
+  // lease validity on exactly this edge, so notify them here — detection
+  // latency is then bounded by quorum_tick_ms instead of the watch
+  // re-stamp interval. The cv is shared across shards; a foreign job's
+  // waiters re-check their own epoch/seq and park again, counters
+  // untouched.
+  if (job.iq.epoch() != job.watched_epoch) {
+    job.watched_epoch = job.iq.epoch();
     cv_.notify_all();
   }
   if (!decision.quorum.has_value()) return;
@@ -184,7 +297,7 @@ void Lighthouse::tick_locked() {
   // lighthouse.rs 272-283); the id is what triggers transport
   // reconfiguration downstream. It also clears participants — each
   // quorum round requires a fresh request from every replica.
-  const QuorumInfo& q = iq_.install(*decision.quorum, wall_ms());
+  const QuorumInfo& q = job.iq.install(*decision.quorum, wall_ms());
   // Serialize the announcement ONCE; each of the n waiters ships these
   // bytes verbatim instead of re-rendering an O(n) member list per RPC.
   ftjson::Object reply;
@@ -195,15 +308,15 @@ void Lighthouse::tick_locked() {
   // expired, it may step with zero control RPCs. Any join / expiry /
   // announcement bumps the epoch and invalidates every outstanding
   // lease — the full Quorum path below is the always-correct fallback.
-  reply["membership_epoch"] = static_cast<int64_t>(iq_.epoch());
+  reply["membership_epoch"] = static_cast<int64_t>(job.iq.epoch());
   reply["lease_ms"] = opts_.lease_ms;
-  watched_epoch_ = iq_.epoch();
-  latest_quorum_body_ = ftjson::Value(std::move(reply)).dump();
-  latest_quorum_ids_.clear();
+  job.watched_epoch = job.iq.epoch();
+  job.latest_quorum_body = ftjson::Value(std::move(reply)).dump();
+  job.latest_quorum_ids.clear();
   for (const auto& p : q.participants) {
-    latest_quorum_ids_.insert(p.replica_id);
+    job.latest_quorum_ids.insert(p.replica_id);
   }
-  quorum_seq_ += 1;
+  job.quorum_seq += 1;
   cv_.notify_all();
 }
 
@@ -223,6 +336,10 @@ Response Lighthouse::handle(const Request& req) {
   if (req.path == "/torchft.LighthouseService/DomainReport" &&
       req.method == "POST") {
     return handle_domain_report(req);
+  }
+  if (req.path == "/torchft.LighthouseService/RegisterJob" &&
+      req.method == "POST") {
+    return handle_register_job(req);
   }
   if (req.path == "/status" && req.method == "GET") {
     return handle_status();
@@ -277,6 +394,9 @@ async function killReplica(id) { await fetch('/replica/' + id + '/kill', {method
 
 Response Lighthouse::handle_quorum(const Request& req) {
   Member requester;
+  std::string job_id = "default";
+  bool has_priority = false, has_group_budget = false, has_rpc_budget = false;
+  int64_t priority = 0, group_budget = 0, rpc_budget = 0;
   try {
     auto body = ftjson::Value::parse(req.body);
     if (!body.has("requester")) {
@@ -284,6 +404,22 @@ Response Lighthouse::handle_quorum(const Request& req) {
                       "{\"error\":\"missing requester\"}"};
     }
     requester = Member::from_json(body.get("requester"));
+    job_id = normalize_job(body.get_str("job_id", "default"));
+    // Registration fields may ride the quorum request (a manager that
+    // was started with a priority re-asserts it on every round, so a
+    // lighthouse restart can't silently forget admissions).
+    if (body.has("priority")) {
+      has_priority = true;
+      priority = body.get_int("priority");
+    }
+    if (body.has("group_budget")) {
+      has_group_budget = true;
+      group_budget = body.get_int("group_budget");
+    }
+    if (body.has("rpc_budget")) {
+      has_rpc_budget = true;
+      rpc_budget = body.get_int("rpc_budget");
+    }
   } catch (const std::exception& e) {
     return Response{400, "application/json",
                     std::string("{\"error\":\"bad request: ") + e.what() +
@@ -291,13 +427,32 @@ Response Lighthouse::handle_quorum(const Request& req) {
   }
 
   std::unique_lock<std::mutex> lk(mu_);
-  quorum_rpcs_ += 1;
+  JobState& job = job_locked(job_id);
+  job.quorum_rpcs += 1;
+  if (has_priority) job.priority = priority;
+  if (has_group_budget) {
+    // Raising (or unlimiting) the budget is the re-admission edge.
+    if (group_budget <= 0 || group_budget > job.group_budget) {
+      job.evicted.clear();
+    }
+    job.group_budget = group_budget;
+  }
+  if (has_rpc_budget) job.rpc_budget = rpc_budget;
+  // Prescriptive eviction: an evicted group's quorum request is answered
+  // immediately with the decision body — it must NEVER park (a timeout
+  // is exactly the failure mode the decision body exists to prevent) and
+  // must NEVER heartbeat/join (that would re-register it as healthy and
+  // hold the survivors' quorum hostage via the split-brain guard).
+  if (job.evicted.count(requester.replica_id)) {
+    return eviction_response(job_id, job);
+  }
   int64_t now = fthttp::now_ms();
   // Implicit heartbeat + join (ref lighthouse.rs:455-478).
-  iq_.heartbeat(requester.replica_id, now);
-  iq_.join(now, requester);
-  uint64_t seen = quorum_seq_;
-  tick_locked();  // proactive evaluation (a cache hit unless state moved)
+  job.iq.heartbeat(requester.replica_id, now);
+  job.iq.join(now, requester);
+  maybe_preempt_locked(job_id, job);
+  uint64_t seen = job.quorum_seq;
+  tick_job_locked(job);  // proactive evaluation (cache hit unless state moved)
 
   // While parked, wake periodically to re-stamp our own heartbeat: a
   // live long-poll IS a liveness signal, which is what lets the manager
@@ -310,18 +465,20 @@ Response Lighthouse::handle_quorum(const Request& req) {
       1, static_cast<int64_t>(opts_.quorum.heartbeat_timeout_ms) / 4);
 
   while (true) {
-    while (quorum_seq_ == seen && !stopping_) {
+    while (job.quorum_seq == seen && !stopping_ &&
+           !job.evicted.count(requester.replica_id)) {
       int64_t now2 = fthttp::now_ms();
       int64_t wake = std::min(req.deadline_ms, now2 + stamp_interval);
       auto deadline =
           std::chrono::steady_clock::now() +
           std::chrono::milliseconds(std::max<int64_t>(1, wake - now2));
       if (cv_.wait_until(lk, deadline) == std::cv_status::timeout &&
-          quorum_seq_ == seen) {
+          job.quorum_seq == seen) {
         if (fthttp::now_ms() >= req.deadline_ms) {
           return Response{504, "application/json",
                           "{\"error\":\"quorum deadline exceeded\"}"};
         }
+        if (job.evicted.count(requester.replica_id)) break;
         // A DEAD long-poll is not a liveness signal: peek the serving
         // socket before stamping — a parked handler never reads it, so
         // a SIGKILLed client would otherwise look alive until the RPC
@@ -338,39 +495,46 @@ Response Lighthouse::handle_quorum(const Request& req) {
                             "{\"error\":\"client disconnected\"}"};
           }
         }
-        iq_.heartbeat(requester.replica_id, fthttp::now_ms());
+        job.iq.heartbeat(requester.replica_id, fthttp::now_ms());
       }
     }
     if (stopping_) {
       return Response{503, "application/json",
                       "{\"error\":\"lighthouse shutting down\"}"};
     }
-    seen = quorum_seq_;
-    if (latest_quorum_ids_.count(requester.replica_id)) break;
+    if (job.evicted.count(requester.replica_id)) {
+      return eviction_response(job_id, job);
+    }
+    seen = job.quorum_seq;
+    if (job.latest_quorum_ids.count(requester.replica_id)) break;
     // Announced quorum doesn't include us: rejoin and wait for the next one
     // (ref lighthouse.rs:480-501).
     int64_t now2 = fthttp::now_ms();
-    iq_.heartbeat(requester.replica_id, now2);
-    iq_.join(now2, requester);
+    job.iq.heartbeat(requester.replica_id, now2);
+    job.iq.join(now2, requester);
   }
 
-  if (opts_.lease_ms > 0) lease_grants_ += 1;
-  return Response{200, "application/json", latest_quorum_body_};
+  if (opts_.lease_ms > 0) job.lease_grants += 1;
+  return Response{200, "application/json", job.latest_quorum_body};
 }
 
 Response Lighthouse::handle_epoch_watch(const Request& req) {
-  // Lease renewal long-poll: park while the membership epoch equals the
-  // watched one, re-stamping the requester's heartbeat (same liveness
+  // Lease renewal long-poll: park while the JOB's membership epoch equals
+  // the watched one, re-stamping the requester's heartbeat (same liveness
   // piggyback as handle_quorum — a parked watch IS the replica's
   // heartbeat, native/manager.cc heartbeat_loop). Returns
   // {epoch, changed}: changed=false at the deadline is a lease renewal;
-  // changed=true means the fleet moved and the caller's lease is dead.
+  // changed=true means the job moved and the caller's lease is dead.
+  // Sharding is the lease-isolation guarantee: a foreign job's churn
+  // bumps a different shard's epoch, so it can never break this lease.
   std::string replica_id;
+  std::string job_id = "default";
   uint64_t watched = 0;
   try {
     auto body = ftjson::Value::parse(req.body);
     replica_id = body.get_str("replica_id");
     watched = static_cast<uint64_t>(body.get_int("epoch"));
+    job_id = normalize_job(body.get_str("job_id", "default"));
   } catch (const std::exception& e) {
     return Response{400, "application/json",
                     std::string("{\"error\":\"bad request: ") + e.what() +
@@ -378,9 +542,21 @@ Response Lighthouse::handle_epoch_watch(const Request& req) {
   }
 
   std::unique_lock<std::mutex> lk(mu_);
-  epoch_watch_rpcs_ += 1;
+  JobState& job = job_locked(job_id);
+  job.epoch_watch_rpcs += 1;
+  // An evicted member's lease is dead by decree: answer immediately
+  // (never park, never stamp — stamping would re-register it).
+  if (job.evicted.count(replica_id)) {
+    job.lease_breaks += 1;
+    ftjson::Object out;
+    out["epoch"] = static_cast<int64_t>(job.iq.epoch());
+    out["changed"] = true;
+    out["evicted"] = true;
+    return Response{200, "application/json",
+                    ftjson::Value(std::move(out)).dump()};
+  }
   int64_t entry = fthttp::now_ms();
-  iq_.heartbeat(replica_id, entry);
+  job.iq.heartbeat(replica_id, entry);
   const int64_t stamp_interval = std::max<int64_t>(
       1, static_cast<int64_t>(opts_.quorum.heartbeat_timeout_ms) / 4);
   // Return a margin BEFORE the RPC deadline: the renewal response must
@@ -391,7 +567,7 @@ Response Lighthouse::handle_epoch_watch(const Request& req) {
       req.deadline_ms -
       std::min<int64_t>(1000, std::max<int64_t>(20, window / 10));
 
-  while (iq_.epoch() == watched && !stopping_ &&
+  while (job.iq.epoch() == watched && !stopping_ &&
          fthttp::now_ms() < watch_deadline) {
     int64_t now = fthttp::now_ms();
     int64_t wake = std::min(watch_deadline, now + stamp_interval);
@@ -399,13 +575,14 @@ Response Lighthouse::handle_epoch_watch(const Request& req) {
         std::chrono::steady_clock::now() +
         std::chrono::milliseconds(std::max<int64_t>(1, wake - now));
     if (cv_.wait_until(lk, deadline) == std::cv_status::timeout &&
-        iq_.epoch() == watched) {
+        job.iq.epoch() == watched) {
       // Run the (cached) decision so expiry edges are observed even if
       // the tick thread is briefly behind; a dead member must break
       // leases from the watch itself, not only from the next tick.
-      (void)iq_.decision(fthttp::now_ms());
-      if (iq_.epoch() != watched) break;
+      (void)job.iq.decision(fthttp::now_ms());
+      if (job.iq.epoch() != watched) break;
       if (fthttp::now_ms() >= watch_deadline) break;
+      if (job.evicted.count(replica_id)) break;
       // Dead-client probe, as in handle_quorum: a SIGKILLed watcher
       // must expire after heartbeat_timeout, not look alive until the
       // RPC deadline.
@@ -419,18 +596,19 @@ Response Lighthouse::handle_epoch_watch(const Request& req) {
                           "{\"error\":\"client disconnected\"}"};
         }
       }
-      iq_.heartbeat(replica_id, fthttp::now_ms());
+      job.iq.heartbeat(replica_id, fthttp::now_ms());
     }
   }
   if (stopping_) {
     return Response{503, "application/json",
                     "{\"error\":\"lighthouse shutting down\"}"};
   }
-  bool changed = iq_.epoch() != watched;
-  if (changed) lease_breaks_ += 1;
+  bool changed = job.iq.epoch() != watched;
+  if (changed) job.lease_breaks += 1;
   ftjson::Object out;
-  out["epoch"] = static_cast<int64_t>(iq_.epoch());
+  out["epoch"] = static_cast<int64_t>(job.iq.epoch());
   out["changed"] = changed;
+  if (job.evicted.count(replica_id)) out["evicted"] = true;
   return Response{200, "application/json",
                   ftjson::Value(std::move(out)).dump()};
 }
@@ -438,19 +616,33 @@ Response Lighthouse::handle_epoch_watch(const Request& req) {
 Response Lighthouse::handle_heartbeat(const Request& req) {
   try {
     auto body = ftjson::Value::parse(req.body);
+    std::string job_id = normalize_job(body.get_str("job_id", "default"));
     int64_t now = fthttp::now_ms();
     std::lock_guard<std::mutex> lk(mu_);
-    heartbeat_rpcs_ += 1;
+    JobState& job = job_locked(job_id);
+    job.heartbeat_rpcs += 1;
+    // Admission rate limit: heartbeats over the job's rpc_budget are
+    // dropped (429) — quorum/watch RPCs are never dropped, they carry
+    // liveness and decisions.
+    if (rate_limited_locked(job, now)) {
+      return Response{429, "application/json",
+                      "{\"error\":\"rate limited\",\"job_id\":\"" + job_id +
+                          "\"}"};
+    }
     if (body.has("replica_ids")) {
       // Batched form: one RPC carries a whole domain's heartbeats (the
       // tier-1 aggregator path; proto LighthouseHeartbeatRequest).
       for (const auto& v : body.get("replica_ids").as_array()) {
-        iq_.heartbeat(v.as_str(), now);
-        heartbeat_ids_ += 1;
+        if (!job.evicted.count(v.as_str())) job.iq.heartbeat(v.as_str(), now);
+        job.heartbeat_ids += 1;
       }
     } else {
-      iq_.heartbeat(body.get_str("replica_id"), now);
-      heartbeat_ids_ += 1;
+      std::string rid = body.get_str("replica_id");
+      // Evicted members' heartbeats are ignored, not errors: the member
+      // learns its fate from its next quorum/watch RPC, and meanwhile it
+      // must not re-enter the healthy set.
+      if (!job.evicted.count(rid)) job.iq.heartbeat(rid, now);
+      job.heartbeat_ids += 1;
     }
   } catch (const std::exception& e) {
     return Response{400, "application/json",
@@ -466,6 +658,7 @@ Response Lighthouse::handle_domain_report(const Request& req) {
     std::string domain = body.get_str("domain");
     s.tier = body.get_int("tier", 1);
     s.address = body.get_str("address", "");
+    s.job_id = normalize_job(body.get_str("job_id", "default"));
     s.healthy = body.get_int("healthy", 0);
     s.participants = body.get_int("participants", 0);
     s.quorum_id = body.get_int("quorum_id", 0);
@@ -482,18 +675,50 @@ Response Lighthouse::handle_domain_report(const Request& req) {
   return Response{200, "application/json", "{}"};
 }
 
+Response Lighthouse::handle_register_job(const Request& req) {
+  // Admission registration: priority class + group/RPC budgets for one
+  // job shard. Registering is idempotent and last-writer-wins; raising
+  // (or unlimiting) the group budget clears the shard's evicted set —
+  // the operator-driven re-admission edge after a preemption.
+  std::string job_id;
+  try {
+    auto body = ftjson::Value::parse(req.body);
+    job_id = normalize_job(body.get_str("job_id", "default"));
+    std::lock_guard<std::mutex> lk(mu_);
+    JobState& job = job_locked(job_id);
+    if (body.has("priority")) job.priority = body.get_int("priority");
+    if (body.has("group_budget")) {
+      int64_t nb = body.get_int("group_budget");
+      if (nb <= 0 || nb > job.group_budget) job.evicted.clear();
+      job.group_budget = nb;
+    }
+    if (body.has("rpc_budget")) job.rpc_budget = body.get_int("rpc_budget");
+    ftjson::Object out;
+    out["job_id"] = job_id;
+    out["priority"] = job.priority;
+    out["group_budget"] = job.group_budget;
+    out["rpc_budget"] = job.rpc_budget;
+    return Response{200, "application/json",
+                    ftjson::Value(std::move(out)).dump()};
+  } catch (const std::exception& e) {
+    return Response{400, "application/json",
+                    std::string("{\"error\":\"") + e.what() + "\"}"};
+  }
+}
+
 Response Lighthouse::handle_status() {
   std::ostringstream html;
   {
     std::lock_guard<std::mutex> lk(mu_);
-    const auto& decision = iq_.decision(fthttp::now_ms());
+    JobState& dj = job_locked("default");
+    const auto& decision = dj.iq.decision(fthttp::now_ms());
     html << "<p>tier " << opts_.tier;
     if (!opts_.domain.empty()) {
       html << " &middot; domain " << html_escape(opts_.domain);
     }
     html << "</p><p>quorum status: " << html_escape(decision.reason)
          << "</p>";
-    const auto& state = iq_.state();
+    const auto& state = dj.iq.state();
     if (state.prev_quorum.has_value()) {
       const auto& q = *state.prev_quorum;
       int64_t max_step = 0;
@@ -528,6 +753,21 @@ Response Lighthouse::handle_status() {
            << "ms</td></tr>";
     }
     html << "</table>";
+    if (jobs_.size() > 1) {
+      html << "<h3>jobs</h3><table><tr><th>job</th><th>priority</th>"
+           << "<th>healthy/budget</th><th>epoch</th><th>preemptions</th>"
+           << "</tr>";
+      for (const auto& kv : jobs_) {
+        const JobState& j = *kv.second;
+        html << "<tr><td>" << html_escape(kv.first) << "</td><td>"
+             << j.priority << "</td><td>" << j.iq.healthy_count() << "/"
+             << (j.group_budget > 0 ? std::to_string(j.group_budget)
+                                    : std::string("∞"))
+             << "</td><td>" << j.iq.epoch() << "</td><td>" << j.preemptions
+             << "</td></tr>";
+      }
+      html << "</table>";
+    }
     if (!domains_.empty()) {
       html << "<h3>domains</h3><table><tr><th>domain</th><th>healthy</th>"
            << "<th>quorum id</th><th>report age</th></tr>";
@@ -544,19 +784,21 @@ Response Lighthouse::handle_status() {
 }
 
 Response Lighthouse::handle_status_json() {
-  // Machine-readable twin of /status: the fleet discovery root. Each
-  // quorum participant entry carries the manager control address AND
-  // the replica group's store address — a poller resolves per-rank
-  // checkpoint/telemetry servers from the store's checkpoint_addr_{r}
-  // keys (the same keys the heal plane's multi-host fan-out uses).
+  // Machine-readable twin of /status: the fleet discovery root. The
+  // root-level shape (reason / quorum / heartbeats / control) renders the
+  // DEFAULT job exactly as the single-tenant lighthouse did — with
+  // control counters summed across shards, so a single-job deployment is
+  // byte-compatible and a multi-job one still satisfies "per-job
+  // counters sum to root totals". The per-job truth lives under "jobs".
   ftjson::Object o;
   {
     std::lock_guard<std::mutex> lk(mu_);
     int64_t now = fthttp::now_ms();
-    const auto& decision = iq_.decision(now);
+    JobState& dj = job_locked("default");
+    const auto& decision = dj.iq.decision(now);
     o["reason"] = decision.reason;
     o["now_ms"] = now;
-    const auto& state = iq_.state();
+    const auto& state = dj.iq.state();
     if (state.prev_quorum.has_value()) {
       const auto& q = *state.prev_quorum;
       o["quorum"] = q.to_json();
@@ -577,32 +819,105 @@ Response Lighthouse::handle_status_json() {
     }
     o["heartbeats"] = ftjson::Value(std::move(hb));
 
+    // Cross-shard sums for the root "control" object.
+    uint64_t sum_compute = 0, sum_cache_hits = 0, sum_epoch = 0;
+    uint64_t sum_hb_rpcs = 0, sum_hb_ids = 0, sum_q_rpcs = 0;
+    uint64_t sum_hb_pruned = 0, sum_part_pruned = 0;
+    uint64_t sum_lease_grants = 0, sum_lease_breaks = 0, sum_watch_rpcs = 0;
+    uint64_t sum_preemptions = 0, sum_rl_drops = 0, sum_healthy = 0;
+    for (const auto& kv : jobs_) {
+      const JobState& j = *kv.second;
+      sum_compute += j.iq.compute_count();
+      sum_cache_hits += j.iq.cache_hits();
+      sum_epoch += j.iq.epoch();
+      sum_hb_rpcs += j.heartbeat_rpcs;
+      sum_hb_ids += j.heartbeat_ids;
+      sum_q_rpcs += j.quorum_rpcs;
+      sum_hb_pruned += j.iq.pruned_heartbeats();
+      sum_part_pruned += j.iq.pruned_participants();
+      sum_lease_grants += j.lease_grants;
+      sum_lease_breaks += j.lease_breaks;
+      sum_watch_rpcs += j.epoch_watch_rpcs;
+      sum_preemptions += j.preemptions;
+      sum_rl_drops += j.rate_limit_drops;
+      sum_healthy += j.iq.healthy_count();
+    }
+
     // Control-plane scaling counters (PR 10): the evidence surface for
     // "recompute count is O(membership changes), not O(RPCs)".
     ftjson::Object ctl;
-    ctl["quorum_compute_count"] =
-        static_cast<int64_t>(iq_.compute_count());
-    ctl["quorum_cache_hits"] = static_cast<int64_t>(iq_.cache_hits());
-    ctl["membership_epoch"] = static_cast<int64_t>(iq_.epoch());
-    ctl["cache_enabled"] = iq_.incremental();
-    ctl["heartbeat_rpcs"] = static_cast<int64_t>(heartbeat_rpcs_);
-    ctl["heartbeat_ids"] = static_cast<int64_t>(heartbeat_ids_);
-    ctl["quorum_rpcs"] = static_cast<int64_t>(quorum_rpcs_);
+    ctl["quorum_compute_count"] = static_cast<int64_t>(sum_compute);
+    ctl["quorum_cache_hits"] = static_cast<int64_t>(sum_cache_hits);
+    ctl["membership_epoch"] = static_cast<int64_t>(sum_epoch);
+    ctl["cache_enabled"] = opts_.cache_quorum;
+    ctl["heartbeat_rpcs"] = static_cast<int64_t>(sum_hb_rpcs);
+    ctl["heartbeat_ids"] = static_cast<int64_t>(sum_hb_ids);
+    ctl["quorum_rpcs"] = static_cast<int64_t>(sum_q_rpcs);
     ctl["domain_reports"] = static_cast<int64_t>(domain_reports_);
     ctl["domains_pruned"] = static_cast<int64_t>(domains_pruned_);
-    ctl["heartbeats_pruned"] =
-        static_cast<int64_t>(iq_.pruned_heartbeats());
-    ctl["participants_pruned"] =
-        static_cast<int64_t>(iq_.pruned_participants());
-    ctl["lease_grants"] = static_cast<int64_t>(lease_grants_);
-    ctl["lease_breaks"] = static_cast<int64_t>(lease_breaks_);
-    ctl["epoch_watch_rpcs"] = static_cast<int64_t>(epoch_watch_rpcs_);
+    ctl["heartbeats_pruned"] = static_cast<int64_t>(sum_hb_pruned);
+    ctl["participants_pruned"] = static_cast<int64_t>(sum_part_pruned);
+    ctl["lease_grants"] = static_cast<int64_t>(sum_lease_grants);
+    ctl["lease_breaks"] = static_cast<int64_t>(sum_lease_breaks);
+    ctl["epoch_watch_rpcs"] = static_cast<int64_t>(sum_watch_rpcs);
     ctl["lease_ms"] = opts_.lease_ms;
-    ctl["healthy_replicas"] = static_cast<int64_t>(iq_.healthy_count());
+    ctl["healthy_replicas"] = static_cast<int64_t>(sum_healthy);
+    ctl["preemptions"] = static_cast<int64_t>(sum_preemptions);
+    ctl["rate_limit_drops"] = static_cast<int64_t>(sum_rl_drops);
+    ctl["fleet_capacity"] = opts_.fleet_capacity;
+    ctl["jobs"] = static_cast<int64_t>(jobs_.size());
     ctl["tier"] = static_cast<int64_t>(opts_.tier);
     ctl["domain"] = opts_.domain;
     ctl["upstream"] = opts_.upstream_addr;
     o["control"] = ftjson::Value(std::move(ctl));
+
+    // Per-job shard truth: one entry per job, counters UNsummed. The
+    // isolation oracle (scripts/bench_fleet.py --jobs) reads exactly
+    // these — churn in job A must leave every other entry's
+    // quorum_compute_count / membership_epoch / lease_breaks untouched.
+    ftjson::Object jobs;
+    for (const auto& kv : jobs_) {
+      const JobState& j = *kv.second;
+      ftjson::Object e;
+      e["priority"] = j.priority;
+      e["group_budget"] = j.group_budget;
+      e["rpc_budget"] = j.rpc_budget;
+      e["healthy"] = static_cast<int64_t>(j.iq.healthy_count());
+      e["participants"] =
+          static_cast<int64_t>(j.iq.state().participants.size());
+      e["membership_epoch"] = static_cast<int64_t>(j.iq.epoch());
+      e["quorum_compute_count"] = static_cast<int64_t>(j.iq.compute_count());
+      e["quorum_cache_hits"] = static_cast<int64_t>(j.iq.cache_hits());
+      e["quorum_rpcs"] = static_cast<int64_t>(j.quorum_rpcs);
+      e["heartbeat_rpcs"] = static_cast<int64_t>(j.heartbeat_rpcs);
+      e["heartbeat_ids"] = static_cast<int64_t>(j.heartbeat_ids);
+      e["lease_grants"] = static_cast<int64_t>(j.lease_grants);
+      e["lease_breaks"] = static_cast<int64_t>(j.lease_breaks);
+      e["epoch_watch_rpcs"] = static_cast<int64_t>(j.epoch_watch_rpcs);
+      e["preemptions"] = static_cast<int64_t>(j.preemptions);
+      e["rate_limit_drops"] = static_cast<int64_t>(j.rate_limit_drops);
+      e["reason"] = j.last_reason;
+      if (!j.evicted.empty()) {
+        ftjson::Array ev;
+        for (const auto& id : j.evicted) ev.push_back(ftjson::Value(id));
+        e["evicted"] = ftjson::Value(std::move(ev));
+      }
+      if (j.iq.state().prev_quorum.has_value()) {
+        const auto& q = *j.iq.state().prev_quorum;
+        e["quorum_id"] = q.quorum_id;
+        e["quorum_age_ms"] = wall_ms() - q.created_ms;
+        int64_t max_step = 0;
+        for (const auto& p : q.participants)
+          max_step = std::max(max_step, p.step);
+        e["max_step"] = max_step;
+        ftjson::Array ids;
+        for (const auto& p : q.participants)
+          ids.push_back(ftjson::Value(p.replica_id));
+        e["quorum_replica_ids"] = ftjson::Value(std::move(ids));
+      }
+      jobs[kv.first] = ftjson::Value(std::move(e));
+    }
+    o["jobs"] = ftjson::Value(std::move(jobs));
 
     // Root side of the two-level tree: one summary row per reporting
     // domain aggregator, with report staleness derived from the
@@ -614,6 +929,7 @@ Response Lighthouse::handle_status_json() {
         ftjson::Object d;
         d["tier"] = s.tier;
         d["address"] = s.address;
+        d["job_id"] = s.job_id;
         d["healthy"] = s.healthy;
         d["participants"] = s.participants;
         d["quorum_id"] = s.quorum_id;
@@ -635,15 +951,16 @@ Response Lighthouse::handle_kill(const std::string& replica_id) {
   std::string manager_addr;
   {
     std::lock_guard<std::mutex> lk(mu_);
-    const auto& state = iq_.state();
-    if (!state.prev_quorum.has_value()) {
-      return Response{500, "text/plain", "failed to find replica"};
-    }
-    for (const auto& m : state.prev_quorum->participants) {
-      if (m.replica_id == replica_id) {
-        manager_addr = m.address;
-        break;
+    for (const auto& kv : jobs_) {
+      const auto& state = kv.second->iq.state();
+      if (!state.prev_quorum.has_value()) continue;
+      for (const auto& m : state.prev_quorum->participants) {
+        if (m.replica_id == replica_id) {
+          manager_addr = m.address;
+          break;
+        }
       }
+      if (!manager_addr.empty()) break;
     }
   }
   if (manager_addr.empty()) {

@@ -7,12 +7,14 @@
 //                                                 replica_ids list)
 //   POST /torchft.LighthouseService/DomainReport (tier-1 aggregator ->
 //                                                 root membership summary)
+//   POST /torchft.LighthouseService/RegisterJob  (admission: priority
+//                                                 class + group/RPC budgets)
 //   GET  /            dashboard HTML
 //   GET  /status      dashboard fragment (polled by the dashboard JS)
 //   GET  /status.json machine-readable fleet status (quorum members with
 //                     manager/store addresses + per-replica heartbeat
-//                     ages + "control" counters + "domains" tree) — the
-//                     discovery root for scripts/fleet_top.py
+//                     ages + "control" counters + "jobs" map + "domains"
+//                     tree) — the discovery root for scripts/fleet_top.py
 //   POST /replica/{id}/kill   proxies a Kill RPC to that replica's manager
 //
 // Design: one mutex + condition_variable guard all state; the quorum RPC
@@ -29,6 +31,21 @@
 // managers can suppress their separate heartbeat RPCs while a quorum
 // request is in flight (the piggyback path, native/manager.cc).
 //
+// Multi-tenant (PR 19): ONE lighthouse multiplexes many jobs. Every RPC
+// carries an optional `job_id` (absent -> job "default", so pre-PR
+// clients keep byte-identical behavior) and lands on that job's SHARD —
+// its own IncrementalQuorum, announcement body/seq, epoch-watch state,
+// and counters. A quorum recompute is therefore O(that job's membership
+// changes): job A's churn causes exactly 0 recomputes, 0 membership-
+// epoch bumps, and 0 lease breaks in job B. Jobs register a priority
+// class plus group/RPC budgets (RegisterJob, or the same fields riding a
+// Quorum request); when the fleet is over `fleet_capacity`, a quorum
+// request from a higher-priority job PREEMPTS one group from the
+// lowest-priority over-budget job — the evicted group learns it from a
+// prescriptive `evicted:true` quorum decision body (never a timeout),
+// and the victim job's epoch bump breaks its leases so the survivors
+// re-form and shrink live through the redistribution planner.
+//
 // Two-level tree: a lighthouse constructed with an upstream address is a
 // tier-1 aggregator for a domain (rack/ICI) of replica groups — it holds
 // the quorum for that domain and reports ONE membership summary upstream
@@ -39,10 +56,13 @@
 
 #include <condition_variable>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <optional>
+#include <set>
 #include <string>
 #include <thread>
+#include <vector>
 
 #include "httpx.h"
 #include "quorum.h"
@@ -72,18 +92,75 @@ struct LighthouseOpts {
   // and renews it off the step path via the EpochWatch long-poll; any
   // membership-epoch bump observed by a watch breaks the lease.
   int64_t lease_ms = 0;
+  // Admission capacity in replica groups, summed over every job's
+  // healthy set (<=0: unlimited, preemption never triggers). While the
+  // fleet is above capacity, a quorum request from a higher-priority job
+  // evicts one group from the lowest-priority over-budget job.
+  int64_t fleet_capacity = 0;
 };
 
 // One aggregator's latest upstream summary, as stored by the root.
 struct DomainSummary {
   int64_t tier = 1;
   std::string address;
+  std::string job_id = "default";
   int64_t healthy = 0;
   int64_t participants = 0;
   int64_t quorum_id = 0;
   int64_t max_step = 0;
   int64_t report_interval_ms = 0;
   int64_t received_ms = 0;  // monotonic, root's clock
+};
+
+// One job's shard of the control plane: its own incremental quorum,
+// announcement state, lease/watch bookkeeping, admission registration,
+// and counters. Guarded by the Lighthouse's mu_ (shards are about
+// recompute/epoch isolation, not lock granularity). Held by unique_ptr
+// and never erased, so JobState& references stay valid across cv waits.
+struct JobState {
+  explicit JobState(const LighthouseOpts& opts)
+      : iq(opts.quorum, opts.cache_quorum, opts.prune_after_ms) {}
+
+  ftquorum::IncrementalQuorum iq;
+  uint64_t quorum_seq = 0;
+  // Serialized once per announcement (the installed quorum itself lives
+  // in iq.state().prev_quorum); every waiter ships these bytes verbatim
+  // instead of re-serializing an O(n) member list per RPC.
+  std::string latest_quorum_body;
+  std::set<std::string> latest_quorum_ids;
+  std::string last_reason;
+  // Last epoch tick_locked saw: an epoch edge from ANY source (join,
+  // expiry sweep, install, evict) wakes parked EpochWatch waiters within
+  // one tick instead of their next re-stamp interval.
+  uint64_t watched_epoch = 0;
+
+  // Admission registration (RegisterJob, or fields riding a Quorum
+  // request body; last writer wins).
+  int64_t priority = 0;      // higher preempts lower
+  int64_t group_budget = 0;  // healthy groups above this are evictable; 0 = unlimited
+  int64_t rpc_budget = 0;    // heartbeat RPCs per second; 0 = unlimited
+  // Rate-limit window (1s tumbling) for rpc_budget.
+  int64_t rpc_window_start_ms = 0;
+  int64_t rpc_window_count = 0;
+
+  // Groups evicted from this job by preemption. A member on this list
+  // gets a prescriptive `evicted:true` decision from every Quorum RPC,
+  // its heartbeats are ignored (so it can't hold the survivors' quorum
+  // hostage via the split-brain guard), and its EpochWatch returns
+  // changed immediately. Cleared by a RegisterJob that raises the
+  // group budget (operator-driven re-admission).
+  std::set<std::string> evicted;
+
+  // Per-job RPC counters (monotonic; surfaced under /status.json
+  // "jobs"; the root "control" object carries their cross-job sums).
+  uint64_t heartbeat_rpcs = 0;
+  uint64_t heartbeat_ids = 0;  // replica ids carried by those RPCs
+  uint64_t quorum_rpcs = 0;
+  uint64_t lease_grants = 0;
+  uint64_t epoch_watch_rpcs = 0;
+  uint64_t lease_breaks = 0;
+  uint64_t preemptions = 0;       // groups evicted FROM this job
+  uint64_t rate_limit_drops = 0;  // heartbeats dropped over rpc_budget
 };
 
 class Lighthouse {
@@ -102,15 +179,30 @@ class Lighthouse {
   fthttp::Response handle_epoch_watch(const fthttp::Request& req);
   fthttp::Response handle_heartbeat(const fthttp::Request& req);
   fthttp::Response handle_domain_report(const fthttp::Request& req);
+  fthttp::Response handle_register_job(const fthttp::Request& req);
   fthttp::Response handle_status();
   fthttp::Response handle_status_json();
   fthttp::Response handle_kill(const std::string& replica_id);
-  // Runs the (cached) decision; on success publishes a new quorum — one
-  // serialization, one id-set — and wakes waiters. Caller must hold mu_.
-  void tick_locked();
+  // Get-or-create the shard for a job id ("" -> "default"). Caller must
+  // hold mu_.
+  JobState& job_locked(const std::string& job_id);
+  // Runs the (cached) decision for one job; on success publishes a new
+  // quorum — one serialization, one id-set — and wakes waiters. Caller
+  // must hold mu_.
+  void tick_job_locked(JobState& job);
   void tick_loop();
-  // Build the upstream DomainReport body from current state (holds mu_).
-  std::string build_domain_report_locked(int64_t now_ms);
+  // Admission check after `claimant` gained a member: while the fleet is
+  // over capacity, evict one group from the lowest-priority over-budget
+  // job with priority strictly below the claimant's. Caller holds mu_.
+  void maybe_preempt_locked(const std::string& claimant_id,
+                            JobState& claimant);
+  // Build the upstream DomainReport bodies — one per job shard, keyed
+  // "<domain>" for the default job and "<domain>/job:<id>" otherwise so
+  // the root's domains map stays one row per (domain, job). Holds mu_.
+  std::vector<std::string> build_domain_reports_locked(int64_t now_ms);
+  // True when the heartbeat should be dropped for exceeding the job's
+  // rpc_budget (counts the drop). Caller holds mu_.
+  bool rate_limited_locked(JobState& job, int64_t now_ms);
 
   LighthouseOpts opts_;
   fthttp::HttpServer server_;
@@ -118,32 +210,15 @@ class Lighthouse {
 
   mutable std::mutex mu_;
   std::condition_variable cv_;
-  ftquorum::IncrementalQuorum iq_;
-  uint64_t quorum_seq_ = 0;
-  // Serialized once per announcement (the installed quorum itself lives
-  // in iq_.state().prev_quorum); every waiter ships these bytes
-  // verbatim instead of re-serializing an O(n) member list per RPC.
-  std::string latest_quorum_body_;
-  std::set<std::string> latest_quorum_ids_;
-  std::string last_reason_;
+  // job_id -> shard. The "default" job is every pre-multi-tenant
+  // client's home and is created eagerly so legacy status payloads
+  // render identically.
+  std::map<std::string, std::unique_ptr<JobState>> jobs_;
   bool stopping_ = false;
 
-  // RPC counters (monotonic; surfaced under /status.json "control").
-  uint64_t heartbeat_rpcs_ = 0;
-  uint64_t heartbeat_ids_ = 0;  // replica ids carried by those RPCs
-  uint64_t quorum_rpcs_ = 0;
+  // Whole-lighthouse counters (not attributable to one job).
   uint64_t domain_reports_ = 0;
   uint64_t domains_pruned_ = 0;
-  // Steady-state fast path (leases): quorum responses that carried a
-  // lease grant / EpochWatch long-polls served / watches that observed
-  // an epoch bump (each one invalidates a manager's lease).
-  uint64_t lease_grants_ = 0;
-  uint64_t epoch_watch_rpcs_ = 0;
-  uint64_t lease_breaks_ = 0;
-  // Last epoch tick_locked saw: an epoch edge from ANY source (join,
-  // expiry sweep, install) wakes parked EpochWatch waiters within one
-  // tick instead of their next re-stamp interval.
-  uint64_t watched_epoch_ = 0;
 
   // Root side of the two-level tree: domain name -> latest summary.
   // Rows silent for far longer than their advertised interval are

@@ -34,6 +34,7 @@ void ManagerServer::start() {
   }
   ftjson::Object hb;
   hb["replica_id"] = opts_.replica_id;
+  hb["job_id"] = opts_.job_id;
   auto res = fthttp::http_post(
       host, port, "/torchft.LighthouseService/Heartbeat",
       ftjson::Value(hb).dump(),
@@ -63,6 +64,7 @@ void ManagerServer::heartbeat_loop() {
   fthttp::parse_http_addr(opts_.lighthouse_addr, &host, &port);
   ftjson::Object hb;
   hb["replica_id"] = opts_.replica_id;
+  hb["job_id"] = opts_.job_id;
   std::string body = ftjson::Value(hb).dump();
   std::unique_lock<std::mutex> lk(mu_);
   while (!stopping_) {
@@ -156,6 +158,7 @@ Response ManagerServer::handle_quorum(const Request& req) {
     fthttp::parse_http_addr(opts_.lighthouse_addr, &host, &port);
     ftjson::Object lh_req;
     lh_req["requester"] = self.to_json();
+    lh_req["job_id"] = opts_.job_id;
     auto res = fthttp::http_post(host, port,
                                  "/torchft.LighthouseService/Quorum",
                                  ftjson::Value(lh_req).dump(),
@@ -177,11 +180,20 @@ Response ManagerServer::handle_quorum(const Request& req) {
     }
     try {
       auto parsed = ftjson::Value::parse(res.body);
-      latest_quorum_ = QuorumInfo::from_json(parsed.get("quorum"));
-      // Epoch lease (absent on pre-lease lighthouses: defaults keep the
-      // fast path disarmed).
-      latest_membership_epoch_ = parsed.get_int("membership_epoch", 0);
-      latest_lease_ms_ = parsed.get_int("lease_ms", 0);
+      if (parsed.get_bool("evicted", false)) {
+        // Prescriptive eviction decision: no member list to install —
+        // record the verdict and wake every fanned-in rank with it.
+        latest_evicted_ = true;
+        latest_membership_epoch_ = parsed.get_int("membership_epoch", 0);
+        latest_lease_ms_ = 0;
+      } else {
+        latest_evicted_ = false;
+        latest_quorum_ = QuorumInfo::from_json(parsed.get("quorum"));
+        // Epoch lease (absent on pre-lease lighthouses: defaults keep the
+        // fast path disarmed).
+        latest_membership_epoch_ = parsed.get_int("membership_epoch", 0);
+        latest_lease_ms_ = parsed.get_int("lease_ms", 0);
+      }
     } catch (const std::exception& e) {
       ftjson::Object err;
       err["error"] = std::string("bad lighthouse response: ") + e.what();
@@ -207,6 +219,13 @@ Response ManagerServer::handle_quorum(const Request& req) {
                     "{\"error\":\"manager shutting down\"}"};
   }
 
+  if (latest_evicted_) {
+    ftjson::Object out;
+    out["evicted"] = true;
+    out["membership_epoch"] = latest_membership_epoch_;
+    out["lease_ms"] = static_cast<int64_t>(0);
+    return Response{200, "application/json", ftjson::Value(out).dump()};
+  }
   try {
     auto results =
         ftquorum::compute_quorum_results(opts_.replica_id, rank,
@@ -238,6 +257,7 @@ Response ManagerServer::handle_epoch_watch(const Request& req) {
   }
   ftjson::Object lh_req;
   lh_req["replica_id"] = opts_.replica_id;
+  lh_req["job_id"] = opts_.job_id;
   lh_req["epoch"] = epoch;
   std::string host;
   int port = 0;

@@ -31,6 +31,10 @@ namespace ftmanager {
 
 struct ManagerOpts {
   std::string replica_id;
+  // Multi-tenant job this replica group belongs to ("" -> "default").
+  // Stamped on every lighthouse RPC so the request lands on the job's
+  // shard; pre-multi-tenant lighthouses ignore the field.
+  std::string job_id = "default";
   std::string lighthouse_addr;  // http://host:port
   std::string hostname = "127.0.0.1";
   std::string bind_host = "0.0.0.0";
@@ -98,6 +102,11 @@ class ManagerServer {
   // response so the Python manager can arm its fast path.
   int64_t latest_membership_epoch_ = 0;
   int64_t latest_lease_ms_ = 0;
+  // Set when the lighthouse answered the group's quorum request with a
+  // prescriptive eviction decision (priority preemption) instead of a
+  // member list; every fanned-in rank then receives {evicted:true} so
+  // the trainer can exit cleanly while the job's survivors shrink.
+  bool latest_evicted_ = false;
 
   // ShouldCommit barrier state. Rounds are keyed by step so a retried
   // vote (pooled-connection resend after a lost reply) can never leak

@@ -426,6 +426,24 @@ const QuorumDecision& IncrementalQuorum::decision(int64_t now_ms) {
   return cached_;
 }
 
+bool IncrementalQuorum::evict(const std::string& replica_id) {
+  bool erased = false;
+  if (healthy_.erase(replica_id)) {
+    auto pit = state_.participants.find(replica_id);
+    if (pit != state_.participants.end()) {
+      remove_healthy_participant(pit->second);
+    }
+    erased = true;
+  }
+  if (state_.participants.erase(replica_id)) {
+    // participants.size() appears in the decision meta string.
+    erased = true;
+  }
+  if (state_.heartbeats.erase(replica_id)) erased = true;
+  if (erased) epoch_ += 1;
+  return erased;
+}
+
 const QuorumInfo& IncrementalQuorum::install(
     const std::vector<Member>& members, int64_t created_wall_ms) {
   if (!state_.prev_quorum.has_value() ||

@@ -160,6 +160,13 @@ class IncrementalQuorum {
   // membership changed), clear participants for the next round.
   const QuorumInfo& install(const std::vector<Member>& members,
                             int64_t created_wall_ms);
+  // Administrative removal (priority preemption): erase the replica's
+  // heartbeat + participant entries in one edge. Returns true (and bumps
+  // the epoch — breaking every lease on it) iff anything was erased.
+  // prev_quorum is left intact: the next round simply forms without the
+  // evicted member (not a fast quorum, but hp==hb once the survivors
+  // rejoin, so no join-timeout stall).
+  bool evict(const std::string& replica_id);
 
   // The decision at now_ms, served from cache when the epoch is
   // unchanged and no time deadline passed.

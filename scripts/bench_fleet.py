@@ -310,6 +310,238 @@ def run_point(world: int, cache_quorum: bool, batch: int = 32,
     return row
 
 
+def _jmember(job: str, i: int, step: int = 0) -> Dict[str, Any]:
+    return {
+        "replica_id": f"{job}_{i:02d}",
+        "address": f"http://{job}-mgr{i}:1",
+        "store_address": f"{job}-store{i}:1",
+        "step": step,
+        "world_size": 1,
+        "shrink_only": False,
+    }
+
+
+def _form_round(addr: str, job: str, ids: List[str], step: int,
+                timeout: float) -> None:
+    """Drive one quorum round for ``job`` the way real managers do: every
+    member RE-REQUESTS until its answer names the full target set. A
+    member that stopped after its first answer would hold the next round
+    hostage on the split-brain guard (healthy ≤ heartbeats/2), so the
+    loop is not a convenience — it is the protocol."""
+    target = set(ids)
+    errors: List[str] = []
+
+    def _req(rid: str) -> None:
+        client = LighthouseClient(addr)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            idx = int(rid.rsplit("_", 1)[1])
+            resp = client.quorum(
+                _jmember(job, idx, step=step), timeout=timeout, job_id=job
+            )
+            got = {
+                p["replica_id"]
+                for p in resp.get("quorum", {}).get("participants", [])
+            }
+            if target <= got:
+                return
+        errors.append(f"{rid}: round never converged to {sorted(target)}")
+
+    threads = [
+        threading.Thread(target=_req, args=(rid,), daemon=True)
+        for rid in ids
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout + 5)
+    if errors:
+        raise RuntimeError(f"job {job} round failed: {errors[0]}")
+
+
+def run_multijob_point(jobs: int, world: int, cache_quorum: bool,
+                       storm_rounds: int = 5, quorum_timeout: float = 60.0
+                       ) -> Dict[str, Any]:
+    """One multi-tenant point: ``jobs`` independent jobs of ``world``
+    groups each behind ONE lighthouse. Job 0 then takes a churn storm
+    (``storm_rounds`` membership changes, each a real re-formation over
+    HTTP) while every other job is silent except for liveness heartbeats
+    and one parked EpochWatch. The cross-job interference oracle pins,
+    per quiet job, Δquorum_compute == 0, Δmembership_epoch == 0 and
+    Δlease_breaks == 0 across the storm window (cached arm — the shipped
+    plane; the recompute arm shows the per-tick evaluation cost sharding
+    does NOT remove, and is reported, not pinned). Liveness and the
+    per-job-sums == root-control-totals identity are pinned in BOTH
+    arms."""
+    lh = Lighthouse(
+        min_replicas=world,
+        join_timeout_ms=OPTS["join_timeout_ms"],
+        quorum_tick_ms=50,
+        heartbeat_timeout_ms=30000,  # quiet jobs are QUIET: nothing may
+        # expire mid-window, or an expiry edge would masquerade as
+        # cross-job interference
+        cache_quorum=cache_quorum,
+    )
+    addr = lh.address()
+    job_names = [f"job_{chr(ord('a') + j)}" for j in range(jobs)]
+    row: Dict[str, Any] = {
+        "jobs": jobs,
+        "world": world,
+        "arm": "cached" if cache_quorum else "recompute",
+        "storm_rounds": storm_rounds,
+    }
+    try:
+        # ---- formation: every job forms its own quorum ----
+        t0 = time.perf_counter()
+        for job in job_names:
+            ids = [f"{job}_{i:02d}" for i in range(world)]
+            _form_round(addr, job, ids, step=0, timeout=quorum_timeout)
+        row["form_ms"] = (time.perf_counter() - t0) * 1e3
+
+        # settle: the tick after install recomputes each job's decision
+        # once (epoch moved at install). Let that land BEFORE the
+        # baseline snapshot, or the oracle would blame it on the storm.
+        time.sleep(0.2)
+        status0 = _status(addr)
+        c0 = {j: dict(status0["jobs"][j]) for j in job_names}
+
+        # ---- park one EpochWatch per quiet job (the lease renewal
+        # path): it must survive the neighbor's storm UNCHANGED ----
+        quiet = job_names[1:]
+        watch_timeout = 4.0
+        watch_changed: Dict[str, Any] = {}
+
+        def _watch(job: str) -> None:
+            client = LighthouseClient(addr)
+            epoch = c0[job]["membership_epoch"]
+            try:
+                _e, changed = client.epoch_watch(
+                    f"{job}_00", epoch, timeout=watch_timeout, job_id=job
+                )
+                watch_changed[job] = changed
+            except Exception as e:  # noqa: BLE001 — a watch ERROR is an
+                # oracle failure too (absent renewal = broken lease)
+                watch_changed[job] = f"error: {e!r}"
+
+        watchers = [
+            threading.Thread(target=_watch, args=(j,), daemon=True)
+            for j in quiet
+        ]
+        for t in watchers:
+            t.start()
+        time.sleep(0.2)  # let the watches park server-side
+
+        # ---- churn storm in job 0: each round adds a member and
+        # re-forms over real HTTP ----
+        storm_job = job_names[0]
+        t1 = time.perf_counter()
+        for r in range(storm_rounds):
+            ids = [f"{storm_job}_{i:02d}" for i in range(world + r + 1)]
+            _form_round(addr, storm_job, ids, step=r + 1,
+                        timeout=quorum_timeout)
+        row["storm_ms"] = (time.perf_counter() - t1) * 1e3
+
+        for t in watchers:
+            t.join(timeout=watch_timeout + 5)
+        row["watch_changed"] = dict(watch_changed)
+
+        status1 = _status(addr)
+        c1 = {j: dict(status1["jobs"][j]) for j in job_names}
+        ctl = status1["control"]
+
+        # ---- oracles ----
+        interference: Dict[str, Any] = {}
+        for job in quiet:
+            interference[job] = {
+                "d_compute": (
+                    c1[job]["quorum_compute_count"]
+                    - c0[job]["quorum_compute_count"]
+                ),
+                "d_epoch": (
+                    c1[job]["membership_epoch"]
+                    - c0[job]["membership_epoch"]
+                ),
+                "d_lease_breaks": (
+                    c1[job]["lease_breaks"] - c0[job]["lease_breaks"]
+                ),
+                "healthy": c1[job]["healthy"],
+            }
+        row["interference"] = interference
+        row["storm_d_epoch"] = (
+            c1[storm_job]["membership_epoch"]
+            - c0[storm_job]["membership_epoch"]
+        )
+        row["healthy"] = {j: c1[j]["healthy"] for j in job_names}
+        # per-job sums must equal the root control totals (the counters
+        # are the evidence plane — a leak here poisons every oracle)
+        sum_keys = (
+            "quorum_rpcs", "heartbeat_rpcs", "epoch_watch_rpcs",
+            "lease_breaks", "preemptions", "rate_limit_drops",
+            "membership_epoch", "quorum_compute_count",
+        )
+        row["sum_check"] = {
+            k: {
+                "root": ctl[k],
+                "jobs_sum": sum(
+                    int(j.get(k, 0)) for j in status1["jobs"].values()
+                ),
+            }
+            for k in sum_keys
+        }
+        row["oracle_failures"] = multijob_oracle(row, world)
+    finally:
+        lh.shutdown()
+    return row
+
+
+def multijob_oracle(row: Dict[str, Any], world: int) -> List[str]:
+    """Grade one multijob row. Pure — unit-testable. Returns failure
+    strings (empty = pass)."""
+    fails: List[str] = []
+    arm = row["arm"]
+    for job, d in row["interference"].items():
+        if arm == "cached" and d["d_compute"] != 0:
+            fails.append(
+                f"{arm} {job}: {d['d_compute']} recomputes leaked from "
+                "the neighbor's churn storm (want exactly 0)"
+            )
+        if d["d_epoch"] != 0:
+            fails.append(
+                f"{arm} {job}: membership epoch moved by {d['d_epoch']} "
+                "with zero membership activity"
+            )
+        if d["d_lease_breaks"] != 0:
+            fails.append(
+                f"{arm} {job}: {d['d_lease_breaks']} lease breaks from "
+                "the neighbor's churn storm"
+            )
+    for job, changed in row.get("watch_changed", {}).items():
+        if changed is not False:
+            fails.append(
+                f"{arm} {job}: parked EpochWatch did not renew "
+                f"unchanged (got {changed!r})"
+            )
+    for job, healthy in row["healthy"].items():
+        if healthy < world:
+            fails.append(
+                f"{arm} {job}: liveness oracle failed "
+                f"({healthy}/{world} healthy)"
+            )
+    if row["storm_d_epoch"] < row["storm_rounds"]:
+        fails.append(
+            f"{arm}: storm job only moved {row['storm_d_epoch']} epochs "
+            f"over {row['storm_rounds']} churn rounds — the storm did "
+            "not actually churn"
+        )
+    for k, chk in row["sum_check"].items():
+        if chk["root"] != chk["jobs_sum"]:
+            fails.append(
+                f"{arm}: control.{k}={chk['root']} != "
+                f"sum over jobs {chk['jobs_sum']}"
+            )
+    return fails
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     ap.add_argument("--worlds", default="16,32,64,128,256",
@@ -323,7 +555,18 @@ def main() -> int:
     ap.add_argument("--out", default=None, help="write JSON artifact here")
     ap.add_argument("--skip-oracle", action="store_true",
                     help="skip the in-process decision-equality replay")
+    ap.add_argument("--jobs", type=int, default=0,
+                    help="run the multi-tenant sweep instead: N jobs "
+                         "behind one lighthouse, churn storm in job 0, "
+                         "cross-job interference oracle on the rest")
+    ap.add_argument("--job-world", type=int, default=4,
+                    help="groups per job in the --jobs sweep")
+    ap.add_argument("--storm-rounds", type=int, default=5,
+                    help="membership changes in the --jobs churn storm")
     args = ap.parse_args()
+
+    if args.jobs > 0:
+        return main_multijob(args)
 
     worlds = [int(w) for w in args.worlds.split(",") if w]
     payload: Dict[str, Any] = {
@@ -400,6 +643,50 @@ def main() -> int:
         print(f"wrote {args.out}")
     print(json.dumps({k: payload[k] for k in
                       ("metric", "worlds", "failures")}))
+    return 1 if failures else 0
+
+
+def main_multijob(args: "argparse.Namespace") -> int:
+    """--jobs N sweep: rep-interleaved cached/recompute arms of the
+    multi-tenant interference point."""
+    payload: Dict[str, Any] = {
+        "metric": "bench_fleet_multijob",
+        "jobs": args.jobs,
+        "job_world": args.job_world,
+        "storm_rounds": args.storm_rounds,
+        "reps": args.reps,
+        "rows": [],
+    }
+    failures: List[str] = []
+    for rep in range(args.reps):
+        for cache in (True, False):  # rep-interleaved A/B
+            row = run_multijob_point(
+                args.jobs, args.job_world, cache,
+                storm_rounds=args.storm_rounds,
+            )
+            row["rep"] = rep
+            payload["rows"].append(row)
+            failures.extend(
+                f"rep={rep} {f}" for f in row["oracle_failures"]
+            )
+            quiet_dc = [
+                d["d_compute"] for d in row["interference"].values()
+            ]
+            print(
+                f"[jobs={args.jobs} {row['arm']:9s} rep={rep}] "
+                f"form={row['form_ms']:7.1f}ms "
+                f"storm={row['storm_ms']:7.1f}ms "
+                f"storm_d_epoch={row['storm_d_epoch']} "
+                f"quiet_d_compute={quiet_dc} "
+                f"oracle={'PASS' if not row['oracle_failures'] else 'FAIL'}",
+                flush=True,
+            )
+    payload["failures"] = failures
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1)
+        print(f"wrote {args.out}")
+    print(json.dumps({k: payload[k] for k in ("metric", "jobs", "failures")}))
     return 1 if failures else 0
 
 

@@ -30,6 +30,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)  # run as a script: the repo root is not on
@@ -1069,6 +1070,173 @@ def fastpath_smoke() -> "list[str]":
     return failures
 
 
+def multijob_smoke() -> "list[str]":
+    """Multi-tenant control plane (ISSUE 19), in-process, three gates:
+
+    1. **interference oracle**: two jobs behind ONE lighthouse; a churn
+       storm in job A must leave job B at exactly 0 recomputes, 0 epoch
+       moves and 0 lease breaks (bench_fleet's multijob point).
+    2. **prescriptive preemption**: with ``fleet_capacity`` exhausted, a
+       higher-priority join evicts exactly one group from the
+       over-budget low-priority job, and the evicted member learns it
+       from the decision body (an immediate ``evicted: true`` answer),
+       never by timeout.
+    3. **planner-lower-bound shrink**: the victim job's live w3→w2
+       shrink rides the planned redistribution exchange with
+       ``redist_moved_bytes == redist_lower_bound_bytes`` on every
+       surviving rank (and a non-zero total — the shrink moved real
+       state)."""
+    import copy
+    import math
+
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    import bench_fleet
+
+    from torchft_tpu.control import Lighthouse, LighthouseClient
+
+    failures: "list[str]" = []
+
+    # -- 1. cross-job interference ------------------------------------
+    try:
+        row = bench_fleet.run_multijob_point(
+            2, 2, cache_quorum=True, storm_rounds=2
+        )
+        failures += [
+            f"multijob smoke: {f}" for f in row["oracle_failures"]
+        ]
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"multijob smoke: interference point failed: {e!r}")
+
+    # -- 2. priority preemption over capacity -------------------------
+    lh = Lighthouse(
+        min_replicas=1, join_timeout_ms=100, quorum_tick_ms=10,
+        heartbeat_timeout_ms=30000, fleet_capacity=3,
+    )
+    try:
+        addr = lh.address()
+        client = LighthouseClient(addr)
+        client.register_job("lo", priority=0, group_budget=2)
+        client.register_job("hi", priority=10)
+        bench_fleet._form_round(
+            addr, "lo", [f"lo_{i:02d}" for i in range(3)], 0, 30.0
+        )
+        bench_fleet._form_round(addr, "hi", ["hi_00"], 0, 30.0)
+        status = bench_fleet._status(addr)
+        jobs = status.get("jobs") or {}
+        lo = jobs.get("lo") or {}
+        if lo.get("preemptions") != 1:
+            failures.append(
+                "multijob smoke: expected exactly 1 preemption in the "
+                f"low job, got {lo.get('preemptions')!r}"
+            )
+        if lo.get("evicted") != ["lo_02"]:
+            failures.append(
+                "multijob smoke: expected lo_02 (max id, minimal "
+                f"eviction) evicted, got {lo.get('evicted')!r}"
+            )
+        if (jobs.get("hi") or {}).get("healthy") != 1:
+            failures.append(
+                "multijob smoke: high-priority job did not seat its "
+                f"group: {jobs.get('hi')!r}"
+            )
+        # prescriptive, not by timeout: the evicted member's next quorum
+        # request is answered immediately with the eviction in the body
+        t0 = time.perf_counter()
+        resp = client.quorum(
+            bench_fleet._jmember("lo", 2, step=1), timeout=30.0,
+            job_id="lo",
+        )
+        answer_ms = (time.perf_counter() - t0) * 1e3
+        if resp.get("evicted") is not True:
+            failures.append(
+                "multijob smoke: evicted member's quorum answer lacks "
+                f"the prescriptive eviction: {resp!r}"
+            )
+        if answer_ms > 5000:
+            failures.append(
+                "multijob smoke: eviction answer took "
+                f"{answer_ms:.0f}ms — that is a timeout, not a decision"
+            )
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"multijob smoke: preemption gate failed: {e!r}")
+    finally:
+        lh.shutdown()
+
+    # -- 3. victim shrink at the planner lower bound ------------------
+    import numpy as np
+    import optax
+
+    import jax
+    import jax.numpy as jnp
+    from torchft_tpu.comm.store import StoreServer
+    from torchft_tpu.comm.transport import TcpCommContext
+    from torchft_tpu.comm.wire_stub import run_stub_ranks
+    from torchft_tpu.optim import ShardedOptimizerWrapper
+
+    store = StoreServer()
+    rng = np.random.default_rng(19)
+    params0 = {
+        f"w{i}": rng.standard_normal(96 + 8 * i).astype(np.float32)
+        for i in range(6)
+    }
+
+    def _run(prefix, world, carried=None):
+        def _fn(mgr, rank):
+            opt = ShardedOptimizerWrapper(mgr, optax.adam(1e-2),
+                                          sharded=True)
+            params = jax.tree_util.tree_map(jnp.asarray, params0)
+            state = (
+                copy.deepcopy(carried[rank])
+                if carried is not None and carried[rank] is not None
+                else opt.init(params)
+            )
+            mgr.start_quorum()
+            grads = jax.tree_util.tree_map(lambda x: x * 0.1, params)
+            params, state, ok = opt.step(params, state, grads)
+            if not ok:
+                raise RuntimeError("multijob smoke step discarded")
+            return state, mgr.metrics.snapshot()
+
+        return run_stub_ranks(
+            store.addr, prefix, world, _fn,
+            lambda: TcpCommContext(timeout=15.0), timeout=90,
+        )
+
+    try:
+        w3 = _run("multijob_w3", 3)
+        shrunk = _run(
+            "multijob_w2", 2, carried=[w3[0][0], w3[1][0]]
+        )
+        total_moved = 0.0
+        for rank, (_, snap) in enumerate(shrunk):
+            moved = snap.get("redist_moved_bytes")
+            lower = snap.get("redist_lower_bound_bytes")
+            if (moved is None or lower is None
+                    or not math.isfinite(float(moved))):
+                failures.append(
+                    f"multijob smoke: shrink rank {rank} redist gauges "
+                    f"missing: moved={moved!r} lower={lower!r}"
+                )
+                continue
+            if float(moved) != float(lower):
+                failures.append(
+                    f"multijob smoke: shrink rank {rank} moved {moved} "
+                    f"!= lower bound {lower} — the victim's shrink "
+                    "over-shipped"
+                )
+            total_moved += float(moved)
+        if not failures and total_moved <= 0:
+            failures.append(
+                "multijob smoke: the w3→w2 victim shrink moved zero "
+                "bytes — the transition exercised nothing"
+            )
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"multijob smoke: shrink gate failed: {e!r}")
+    finally:
+        store.shutdown()
+    return failures
+
+
 def main() -> int:
     env = {
         k: v for k, v in os.environ.items()
@@ -1121,6 +1289,7 @@ def main() -> int:
     failures += fleet_smoke()
     failures += pipeline_smoke()
     failures += fastpath_smoke()
+    failures += multijob_smoke()
     for key in ("t1_pipeline_overlap", "t1_pipeline_ms", "t1_ddp_streamed",
                 "t1_overhead_ms", "t1_outer_overlap", "t1_outer_wire_ms",
                 "comm_backend", "t1_events_recorded",
@@ -1179,7 +1348,7 @@ def main() -> int:
         "heal_gauges=ok outer_gauges=ok xla_gauges=ok qpsum_gauges=ok "
         "hier_gauges=ok chrome_trace=ok sharded_gauges=ok "
         "redist_gauges=ok fused_gauges=ok fleet_gauges=ok "
-        "pipe_gauges=ok"
+        "pipe_gauges=ok multijob=ok"
     )
     return 0
 

@@ -327,7 +327,100 @@ def render_tree(status: Dict[str, Any]) -> List[str]:
     return out
 
 
-def render(status: Dict[str, Any], rows: List[Dict[str, Any]]) -> str:
+def build_job_rows(
+    status: Dict[str, Any],
+    prev_rpc: Optional[Dict[str, float]] = None,
+) -> List[Dict[str, Any]]:
+    """One row per tenant job from the root's ``jobs{}`` map (PR 19's
+    sharded lighthouse). Pure — unit tested against canned payloads.
+
+    ``prev_rpc``: previous poll's cumulative control-RPC count per job
+    (quorum + heartbeat + epoch-watch); the Δrpc column is the
+    between-polls delta, so a churning job reads as a hot row while its
+    neighbors sit at 0 — the isolation story at a glance. Rows carry the
+    raw cumulative count back under ``_rpc`` for the caller's cache.
+
+    Pre-multijob lighthouses emit no ``jobs{}`` — returns ``[]`` and the
+    screen renders exactly as before. A job with no healthy members (or
+    that never formed a quorum) is flagged unreachable rather than
+    silently dropped: a starved tenant is the row the operator needs."""
+    jobs = status.get("jobs")
+    if not isinstance(jobs, dict):
+        return []
+    rows: List[Dict[str, Any]] = []
+    for name, j in sorted(jobs.items()):
+        budget = j.get("group_budget", 0) or 0
+        healthy = j.get("healthy", 0)
+        rpc = float(
+            (j.get("quorum_rpcs") or 0)
+            + (j.get("heartbeat_rpcs") or 0)
+            + (j.get("epoch_watch_rpcs") or 0)
+        )
+        age_ms = j.get("quorum_age_ms")
+        row: Dict[str, Any] = {
+            "job": str(name)[:24],
+            "prio": j.get("priority", 0),
+            "groups": f"{healthy}/{budget if budget > 0 else '∞'}",
+            "epoch": j.get("membership_epoch"),
+            "q_age_s": None if age_ms is None else age_ms / 1000.0,
+            "d_rpc": None,
+            "preempt": j.get("preemptions"),
+            "drops": j.get("rate_limit_drops"),
+            "evicted": len(j.get("evicted") or ()),
+            "flag": "",
+            "_rpc": rpc,
+            "_name": str(name),
+        }
+        if prev_rpc and name in prev_rpc:
+            delta = rpc - prev_rpc[name]
+            # backwards counter = restarted lighthouse (fresh shard):
+            # show the whole cumulative value, not a negative delta
+            row["d_rpc"] = int(delta if delta >= 0 else rpc)
+        if not healthy or "quorum_id" not in j:
+            row["flag"] = "** UNREACHABLE: no live quorum **"
+        if budget > 0 and healthy > budget:
+            row["flag"] = (row["flag"] + " over budget").strip()
+        rows.append(row)
+    return rows
+
+
+_JOB_COLUMNS = (
+    ("job", 24), ("prio", 5), ("groups", 7), ("epoch", 6),
+    ("q_age_s", 8), ("d_rpc", 6), ("preempt", 8), ("drops", 6),
+    ("evicted", 8),
+)
+
+
+def render_jobs(status: Dict[str, Any],
+                job_rows: List[Dict[str, Any]]) -> List[str]:
+    """Jobs-view lines (empty on pre-multijob payloads): fleet capacity
+    header + one row per tenant with priority, groups vs budget, quorum
+    age and the Δrpc activity column."""
+    if not job_rows:
+        return []
+    ctl = status.get("control") or {}
+    cap = ctl.get("fleet_capacity", 0) or 0
+    out = [
+        f"jobs ({len(job_rows)}) · fleet_capacity="
+        f"{cap if cap > 0 else '∞'} · "
+        f"preemptions={ctl.get('preemptions', 0)} · "
+        f"rate_limit_drops={ctl.get('rate_limit_drops', 0)}"
+    ]
+    hdr = " ".join(name.ljust(w) for name, w in _JOB_COLUMNS)
+    out.append("  " + hdr)
+    for row in job_rows:
+        cells = [
+            _fmt(row.get(name), 1).ljust(w) for name, w in _JOB_COLUMNS
+        ]
+        line = "  " + " ".join(cells)
+        if row.get("flag"):
+            line += f" {row['flag']}"
+        out.append(line)
+    return out
+
+
+def render(status: Dict[str, Any], rows: List[Dict[str, Any]],
+           job_rows: Optional[List[Dict[str, Any]]] = None) -> str:
     out = []
     q = status.get("quorum", {})
     out.append(
@@ -338,6 +431,14 @@ def render(status: Dict[str, Any], rows: List[Dict[str, Any]]) -> str:
     )
     out.append(f"  {status.get('reason', '')}")
     out.extend(render_tree(status))
+    if job_rows is None:
+        job_rows = build_job_rows(status)
+    # single default tenant = pre-multijob screen, byte-identical; any
+    # second job (or a non-default name) brings the jobs view up
+    if job_rows and not (
+        len(job_rows) == 1 and job_rows[0]["job"] == "default"
+    ):
+        out.extend(render_jobs(status, job_rows))
     hdr = " ".join(name.ljust(w) for name, w in _COLUMNS)
     out.append(hdr)
     out.append("-" * len(hdr))
@@ -403,6 +504,7 @@ def main() -> int:
     cursors: Dict[str, int] = {}
     last_events: Dict[str, Dict[str, Any]] = {}
     prev_counters: Dict[str, Dict[str, float]] = {}
+    prev_job_rpc: Dict[str, float] = {}
 
     def _poll_one(ep: Dict[str, Any]) -> Dict[str, Any]:
         url = ep.get("url")
@@ -444,9 +546,12 @@ def main() -> int:
                 rows = list(pool.map(_poll_one, endpoints))
         else:
             rows = []
+        job_rows = build_job_rows(status, prev_job_rpc)
+        for jr in job_rows:
+            prev_job_rpc[jr["_name"]] = jr["_rpc"]
         if not args.once:
             sys.stdout.write("\x1b[2J\x1b[H")  # clear screen, home
-        print(render(status, rows))
+        print(render(status, rows, job_rows))
         if args.trace:
             trace = gather_trace(endpoints, args.timeout)
             with open(args.trace, "w") as f:

@@ -1,0 +1,459 @@
+"""Multi-tenant control plane (ISSUE 19), end to end.
+
+Two live jobs of REAL Managers share ONE native lighthouse. The claims
+under test are exactly the tenancy invariants:
+
+- a kill inside job A heals through the normal quorum path while job
+  B's shard counters (membership_epoch / quorum_compute_count /
+  lease_breaks) do not move and B keeps stepping at zero control RPCs;
+- a higher-priority job arriving over ``fleet_capacity`` preempts the
+  over-budget low-priority job PRESCRIPTIVELY (the eviction arrives in
+  a decision body, never by timeout), and the victim shrinks through
+  the redistribution planner at exactly the lower bound;
+- a legacy client that never says ``job_id`` lands in the ``"default"``
+  shard and sees the exact pre-multijob wire shapes.
+
+Everything observable is reconstructed from /telemetry/events +
+/status.json (plus the managers' public accessors) — no reaching into
+lighthouse internals.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.comm.store import StoreClient, StoreServer
+from torchft_tpu.control import Lighthouse, LighthouseClient
+from torchft_tpu.manager import Manager
+
+
+def _status(lighthouse):
+    with urllib.request.urlopen(
+        lighthouse.address() + "/status.json", timeout=10
+    ) as r:
+        return json.load(r)
+
+
+def _telemetry(store, key, what):
+    url = StoreClient(store.addr, connect_timeout=5.0).get(key).decode()
+    with urllib.request.urlopen(url + "/telemetry/" + what, timeout=10) as r:
+        return json.load(r)
+
+
+def _make_manager(store, lighthouse, replica_id, job_id, **kwargs):
+    defaults = dict(
+        min_replica_size=1,
+        rank=0, world_size=1,
+        store_addr=store.addr,
+        lighthouse_addr=lighthouse.address(),
+        replica_id=replica_id,
+        job_id=job_id,
+        timeout=20.0, quorum_timeout=20.0, connect_timeout=20.0,
+        heartbeat_interval=0.05,
+        use_async_quorum=False,
+    )
+    defaults.update(kwargs)
+    return Manager(**defaults)
+
+
+def _step(manager):
+    manager.start_quorum(allow_heal=False)
+    manager.allreduce_arrays(
+        [np.ones(8, np.float32)]
+    ).future().result(timeout=20)
+    return manager.should_commit()
+
+
+# ------------------------------------------------------------ kill isolation
+
+
+def test_kill_in_job_a_leaves_job_b_untouched(monkeypatch) -> None:
+    """Job A loses a replica mid-run; A heals through the normal lease
+    break -> full quorum path while job B's shard never moves: its
+    membership epoch, recompute count and lease-break count stay at the
+    pre-kill baseline and every B step during the heal window issues
+    exactly 0 control RPCs."""
+    monkeypatch.setenv("TORCHFT_TPU_FASTPATH", "1")
+    lh = Lighthouse(
+        min_replicas=1, join_timeout_ms=100, quorum_tick_ms=10,
+        heartbeat_timeout_ms=1200, lease_ms=2000,
+    )
+    stores = [StoreServer() for _ in range(3)]
+    managers = []
+    try:
+        b = _make_manager(stores[0], lh, "mj_b_", "b")
+        managers.append(b)
+        assert _step(b)
+
+        # Job a is a TWO-group job whose members allreduce together, so
+        # they are created together (allow_heal=False rounds can only
+        # shrink — a solo quorum could never grow to admit a1) and step
+        # in lockstep; short timeouts keep the post-kill discards
+        # (dead-peer allreduce) cheap.
+        a0, a1 = (
+            _make_manager(
+                stores[1 + i], lh, f"mj_a{i}_", "a",
+                timeout=5.0, quorum_timeout=5.0, connect_timeout=5.0,
+            )
+            for i in range(2)
+        )
+        managers.extend([a0, a1])
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if all(pool.map(_step, [a0, a1])):
+                    break
+            else:
+                pytest.fail("job a never converged to a joint quorum")
+
+        # Let the shard settle: a quorum install bumps the job's epoch
+        # and the NEXT tick recomputes once — that recompute must land
+        # before the baseline or it gets misattributed to the kill.
+        time.sleep(0.3)
+        base = _status(lh)["jobs"]
+        assert set(base) >= {"a", "b"}
+
+        def _a0_breaks():
+            return sum(
+                1 for e in a0.events.since(0)[0]
+                if e["kind"] == "lease_break"
+            )
+
+        breaks_before_kill = _a0_breaks()
+
+        # Kill a1 abruptly (stops heartbeating; never deregisters).
+        a1.shutdown(wait=False)
+
+        # Drive both jobs through the heal window: a0 must observe the
+        # kill (a fresh lease break), then come back to sustained solo
+        # commits; b must stay on the zero-RPC fast path throughout.
+        b_rpcs = []
+        a_commits_after_break = 0
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and a_commits_after_break < 2:
+            committed = _step(a0)
+            if committed and _a0_breaks() > breaks_before_kill:
+                a_commits_after_break += 1
+            if not committed:
+                time.sleep(0.3)  # let the dead peer age out
+            assert _step(b)
+            b_rpcs.append(b._control_rpcs)
+        assert _a0_breaks() > breaks_before_kill, (
+            "a0 never observed the kill (no lease break)"
+        )
+        assert a_commits_after_break >= 2, "job a did not heal to solo commits"
+        assert sum(b_rpcs) == 0, (
+            f"job b paid control RPCs during job a's heal: {b_rpcs}"
+        )
+
+        after = _status(lh)["jobs"]
+        for key in ("membership_epoch", "quorum_compute_count",
+                    "lease_breaks"):
+            assert after["b"][key] == base["b"][key], (
+                f"job b {key} moved during job a churn: "
+                f"{base['b'][key]} -> {after['b'][key]}"
+            )
+        assert after["a"]["membership_epoch"] > base["a"]["membership_epoch"]
+        assert after["a"]["healthy"] == 1  # a1 aged out, a0 healed solo
+
+        # Per-job counters sum to the root totals (the isolation ledger
+        # never double- or under-counts).
+        control = _status(lh)["control"]
+        jobs = _status(lh)["jobs"]
+        for key in ("quorum_rpcs", "lease_breaks", "preemptions",
+                    "rate_limit_drops"):
+            assert control[key] == sum(j[key] for j in jobs.values()), key
+
+        # And the manager's own telemetry names its tenant.
+        tel = _telemetry(stores[0], "job:b/checkpoint_addr_0", "metrics")
+        assert tel["job_id"] == "b"
+        assert tel["evicted"] is False
+        assert tel["control_rpcs_per_step"] == 0
+    finally:
+        for m in managers:
+            try:
+                m.shutdown(wait=False)
+            except Exception:  # noqa: BLE001
+                pass
+        for s in stores:
+            s.shutdown()
+        lh.shutdown()
+
+
+# --------------------------------------------------------------- preemption
+
+
+def test_priority_preemption_is_prescriptive_and_victim_shrinks() -> None:
+    """Three low-priority groups (budget 2) fill ``fleet_capacity``; a
+    high-priority join evicts exactly one of them via the decision body
+    (Manager.is_evicted + a ``job_preempted`` telemetry event), and the
+    victim job's 3->2 shrink rides the planned redistribution exchange
+    at exactly the lower bound."""
+    lh = Lighthouse(
+        min_replicas=1, join_timeout_ms=100, quorum_tick_ms=10,
+        heartbeat_timeout_ms=30000, fleet_capacity=3,
+    )
+    stores = [StoreServer() for _ in range(4)]
+    managers = []
+    try:
+        client = LighthouseClient(lh.address())
+        client.register_job("lo", priority=0, group_budget=2)
+        client.register_job("hi", priority=10)
+
+        lo = [
+            _make_manager(stores[i], lh, f"mj_lo{i}_", "lo")
+            for i in range(3)
+        ]
+        managers.extend(lo)
+        # Every group must request each round (the split-brain guard
+        # stalls a round whose participants are a minority of the
+        # healthy set), so the whole job steps concurrently.
+        with ThreadPoolExecutor(max_workers=3) as pool:
+            assert all(pool.map(_step, lo))
+
+        hi = _make_manager(stores[3], lh, "mj_hi_", "hi")
+        managers.append(hi)
+        assert _step(hi)  # the claimant's quorum carries the preemption
+
+        time.sleep(0.5)  # let the eviction epoch bump reach lease watchers
+
+        def _drive(mgr):
+            mgr.start_quorum(allow_heal=False)
+            if mgr.is_evicted():
+                return "evicted"
+            mgr.allreduce_arrays(
+                [np.ones(8, np.float32)]
+            ).future().result(timeout=20)
+            return mgr.should_commit()
+
+        with ThreadPoolExecutor(max_workers=3) as pool:
+            outcomes = list(pool.map(_drive, lo))
+        assert outcomes.count("evicted") == 1, outcomes
+        assert outcomes.count(True) == 2, outcomes
+        victim = lo[outcomes.index("evicted")]
+        victim_store = stores[outcomes.index("evicted")]
+
+        # Reconstruction from /status.json: exactly one prescriptive
+        # eviction, charged to the victim job, minimal (one group).
+        status = _status(lh)
+        jobs = status["jobs"]
+        assert jobs["lo"]["preemptions"] == 1
+        assert jobs["hi"]["preemptions"] == 0
+        assert jobs["lo"]["evicted"] == [victim._replica_id]
+        assert jobs["hi"]["healthy"] == 1
+        assert status["control"]["preemptions"] == 1
+        assert status["control"]["fleet_capacity"] == 3
+
+        # Reconstruction from /telemetry/events: the victim announced
+        # its own preemption with its tenant attached.
+        tel = _telemetry(
+            victim_store, "job:lo/checkpoint_addr_0", "events"
+        )
+        preempted = [
+            e for e in tel["events"] if e["kind"] == "job_preempted"
+        ]
+        assert preempted and preempted[0]["job_id"] == "lo"
+
+        # Prescriptive means a decision body, never a timeout: the
+        # evicted group's next ask is answered immediately.
+        t0 = time.perf_counter()
+        resp = client.quorum(
+            {
+                "replica_id": victim._replica_id,
+                "address": "http://localhost:1",
+                "store_address": "localhost:1",
+                "step": 1,
+                "world_size": 1,
+            },
+            timeout=30.0,
+            job_id="lo",
+        )
+        assert resp.get("evicted") is True, resp
+        assert (time.perf_counter() - t0) < 5.0
+    finally:
+        for m in managers:
+            try:
+                m.shutdown(wait=False)
+            except Exception:  # noqa: BLE001
+                pass
+        for s in stores:
+            s.shutdown()
+        lh.shutdown()
+
+
+def test_victim_shrink_moves_exactly_the_lower_bound() -> None:
+    """The evicted group's state leaves the job through the PR 14
+    planner: a live 3->2 shrink of a sharded optimizer must ship
+    ``redist_moved_bytes == redist_lower_bound_bytes`` on every
+    surviving rank (and a non-zero total — real state moved), with the
+    plan reconstructed from the ``redist_plan`` event stream."""
+    import copy
+
+    import optax
+
+    import jax
+    import jax.numpy as jnp
+    from torchft_tpu.comm.transport import TcpCommContext
+    from torchft_tpu.comm.wire_stub import run_stub_ranks
+    from torchft_tpu.optim import ShardedOptimizerWrapper
+
+    store = StoreServer()
+    rng = np.random.default_rng(1909)
+    params0 = {
+        f"w{i}": rng.standard_normal(64 + 8 * i).astype(np.float32)
+        for i in range(4)
+    }
+
+    def _run(prefix, world, carried=None):
+        def _fn(mgr, rank):
+            opt = ShardedOptimizerWrapper(mgr, optax.adam(1e-2),
+                                          sharded=True)
+            params = jax.tree_util.tree_map(jnp.asarray, params0)
+            state = (
+                copy.deepcopy(carried[rank])
+                if carried is not None and carried[rank] is not None
+                else opt.init(params)
+            )
+            mgr.start_quorum()
+            grads = jax.tree_util.tree_map(lambda x: x * 0.1, params)
+            params, state, ok = opt.step(params, state, grads)
+            assert ok, "shrink step discarded"
+            events = mgr.events.since(0)[0]
+            plans = [e for e in events if e["kind"] == "redist_plan"]
+            snap = mgr.metrics.snapshot()
+            return state, snap, plans
+
+        return run_stub_ranks(
+            store.addr, prefix, world, _fn,
+            lambda: TcpCommContext(timeout=15.0), timeout=90,
+        )
+
+    try:
+        w3 = _run("mj_shrink_w3", 3)
+        shrunk = _run(
+            "mj_shrink_w2", 2, carried=[w3[0][0], w3[1][0]]
+        )
+        total_moved = 0.0
+        for rank, (_, snap, plans) in enumerate(shrunk):
+            moved = snap.get("redist_moved_bytes")
+            lower = snap.get("redist_lower_bound_bytes")
+            assert moved is not None and lower is not None, (
+                f"rank {rank}: redistribution gauges missing"
+            )
+            assert float(moved) == float(lower), (
+                f"rank {rank}: victim shrink over-shipped "
+                f"({moved} vs lower bound {lower})"
+            )
+            assert plans, f"rank {rank}: no redist_plan event recorded"
+            assert plans[-1]["moved_bytes"] == int(moved)
+            assert plans[-1]["lower_bound_bytes"] == int(lower)
+            total_moved += float(moved)
+        assert total_moved > 0, "the 3->2 shrink moved zero bytes"
+    finally:
+        store.shutdown()
+
+
+# ------------------------------------------------------------ legacy clients
+
+
+def _legacy_member(i, step=0):
+    return {
+        "replica_id": f"legacy_{i:02d}",
+        "address": f"http://localhost:{2000 + i}",
+        "store_address": f"localhost:{3000 + i}",
+        "step": step,
+        "world_size": 1,
+    }
+
+
+def test_legacy_clients_land_in_default_job() -> None:
+    """Clients that never mention ``job_id`` get the exact pre-multijob
+    contract: they form quorum in the ``"default"`` shard, the response
+    body carries the PR 18 keys and nothing multi-tenant, and the root
+    of /status.json mirrors the default shard byte for byte."""
+    lh = Lighthouse(
+        min_replicas=2, join_timeout_ms=200, quorum_tick_ms=10,
+        heartbeat_timeout_ms=30000,
+    )
+    try:
+        addr = lh.address()
+        want = {"legacy_00", "legacy_01"}
+        responses = [None, None]
+
+        def _q_until(i):
+            # Loop until the announced quorum names the FULL target set:
+            # a member that stops re-asking after its own early answer
+            # starves the next round behind the split-brain guard.
+            client = LighthouseClient(addr)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                resp = client.quorum(_legacy_member(i), timeout=2.0)
+                got = {
+                    p["replica_id"]
+                    for p in resp.get("quorum", {}).get("participants", [])
+                }
+                if want <= got:
+                    responses[i] = resp
+                    return
+            raise AssertionError(f"legacy member {i} never saw full quorum")
+
+        threads = [
+            threading.Thread(target=_q_until, args=(i,)) for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+            assert not t.is_alive()
+
+        for resp in responses:
+            assert resp is not None
+            # The PR 18 announcement shape, exactly: no job_id, no
+            # evicted, nothing a pre-multijob client could trip on.
+            assert set(resp) == {"quorum", "membership_epoch", "lease_ms"}
+
+        status = _status(lh)
+        assert set(status["jobs"]) == {"default"}
+        dj = status["jobs"]["default"]
+        assert status["quorum"]["quorum_id"] == dj["quorum_id"]
+        assert sorted(
+            p["replica_id"] for p in status["quorum"]["participants"]
+        ) == sorted(dj["quorum_replica_ids"])
+        # Single tenant: root control sums degenerate to the one shard.
+        assert status["control"]["quorum_rpcs"] == dj["quorum_rpcs"]
+        assert status["control"]["membership_epoch"] == dj[
+            "membership_epoch"
+        ]
+
+        # job_id-less heartbeats and epoch watches hit the same shard.
+        client = LighthouseClient(addr)
+        client.heartbeat("legacy_hb")
+        status = _status(lh)
+        assert "legacy_hb" in status["heartbeats"]
+        assert status["jobs"]["default"]["heartbeat_rpcs"] >= 1
+
+        epoch = status["jobs"]["default"]["membership_epoch"]
+        t0 = time.monotonic()
+        new_epoch, changed = client.epoch_watch(
+            "legacy_00", epoch, timeout=0.3
+        )
+        assert not changed and new_epoch == epoch  # parked, then renewed
+        assert time.monotonic() - t0 >= 0.1
+        waker = threading.Timer(
+            0.2, LighthouseClient(addr).heartbeat, ("legacy_stranger",)
+        )
+        waker.start()
+        try:
+            new_epoch, changed = client.epoch_watch(
+                "legacy_00", epoch, timeout=10.0
+            )
+        finally:
+            waker.join()
+        assert changed and new_epoch > epoch
+    finally:
+        lh.shutdown()

@@ -77,10 +77,21 @@ class QuorumResult:
     # manager steps with zero control RPCs.
     membership_epoch: int = 0
     lease_ms: int = 0
+    # Prescriptive eviction (multi-tenant priority preemption): the
+    # lighthouse answered the group's quorum request with an eviction
+    # decision instead of a member list. No other field is meaningful;
+    # the trainer should exit cleanly while the job's survivors shrink.
+    evicted: bool = False
 
     @staticmethod
     def from_json(payload: str) -> "QuorumResult":
         d = json.loads(payload)
+        if d.get("evicted"):
+            return QuorumResult(
+                evicted=True,
+                membership_epoch=d.get("membership_epoch", 0),
+                lease_ms=0,
+            )
         return QuorumResult(
             quorum_id=d["quorum_id"],
             replica_rank=d["replica_rank"],
@@ -140,6 +151,7 @@ class Lighthouse:
         upstream_addr: Optional[str] = None,
         upstream_report_interval_ms: Optional[int] = None,
         lease_ms: Optional[int] = None,
+        fleet_capacity: Optional[int] = None,
     ) -> None:
         host, port = _split_bind(bind)
         lib = get_lib()
@@ -159,6 +171,11 @@ class Lighthouse:
             )
         if lease_ms is not None:
             extra["lease_ms"] = int(lease_ms)
+        if fleet_capacity is not None:
+            # Admission capacity in replica groups summed across jobs;
+            # above it, higher-priority quorum requests preempt groups
+            # from the lowest-priority over-budget job.
+            extra["fleet_capacity"] = int(fleet_capacity)
         self._handle = lib.ft_lighthouse_new(
             host.encode(),
             port,
@@ -205,6 +222,7 @@ class ManagerServer:
         heartbeat_interval: "float | timedelta" = 0.1,
         connect_timeout: "float | timedelta" = 10.0,
         exit_on_kill: bool = True,
+        job_id: str = "default",
     ) -> None:
         if hostname is None:
             # The advertised address crosses hosts (it becomes peers'
@@ -226,6 +244,7 @@ class ManagerServer:
             _ms(heartbeat_interval, 100),
             _ms(connect_timeout, 10000),
             1 if exit_on_kill else 0,
+            json.dumps({"job_id": job_id or "default"}).encode(),
             ctypes.byref(err),
         )
         check_error(err)
@@ -376,31 +395,110 @@ class LighthouseClient:
         self,
         replica_id: "str | List[str]",
         timeout: "float | timedelta" = 5.0,
+        job_id: Optional[str] = None,
     ) -> None:
         """Heartbeat one replica id, or a whole batch in ONE RPC (a list
         posts the ``replica_ids`` wire form — the per-domain aggregation
-        that cuts steady-state heartbeat RPCs ~len(batch)x)."""
+        that cuts steady-state heartbeat RPCs ~len(batch)x). ``job_id``
+        routes the heartbeat to that job's shard (absent → "default")."""
+        if job_id is not None:
+            body: dict = (
+                {"replica_ids": replica_id}
+                if isinstance(replica_id, list)
+                else {"replica_id": replica_id}
+            )
+            body["job_id"] = job_id
+            payload = json.dumps(body)
+        else:
+            payload = json.dumps(replica_id)
         err = ctypes.c_char_p()
         get_lib().ft_lighthouse_client_heartbeat2(
             self._handle,
-            json.dumps(replica_id).encode(),
+            payload.encode(),
             _ms(timeout),
             ctypes.byref(err),
         )
         check_error(err)
 
     def quorum(
-        self, requester: dict, timeout: "float | timedelta" = 60.0
+        self,
+        requester: dict,
+        timeout: "float | timedelta" = 60.0,
+        job_id: Optional[str] = None,
+        extra: Optional[dict] = None,
     ) -> dict:
+        """Lighthouse quorum long-poll. ``job_id`` lands the request on
+        that job's shard; ``extra`` merges additional top-level request
+        fields (e.g. ``priority``/``group_budget`` riding the request)."""
+        if job_id is not None or extra:
+            body = {"requester": requester}
+            if job_id is not None:
+                body["job_id"] = job_id
+            if extra:
+                body.update(extra)
+            payload = json.dumps(body)
+        else:
+            payload = json.dumps(requester)
         err = ctypes.c_char_p()
         ptr = get_lib().ft_lighthouse_client_quorum2(
             self._handle,
-            json.dumps(requester).encode(),
+            payload.encode(),
             _ms(timeout),
             ctypes.byref(err),
         )
         check_error(err)
         return json.loads(take_string(ptr))
+
+    def post(self, path: str, body: dict, timeout: "float | timedelta" = 10.0) -> dict:
+        """Generic lighthouse POST (RegisterJob, raw EpochWatch, ...)."""
+        err = ctypes.c_char_p()
+        ptr = get_lib().ft_lighthouse_client_post(
+            self._handle,
+            path.encode(),
+            json.dumps(body).encode(),
+            _ms(timeout),
+            ctypes.byref(err),
+        )
+        check_error(err)
+        return json.loads(take_string(ptr))
+
+    def register_job(
+        self,
+        job_id: str,
+        priority: Optional[int] = None,
+        group_budget: Optional[int] = None,
+        rpc_budget: Optional[int] = None,
+        timeout: "float | timedelta" = 10.0,
+    ) -> dict:
+        """Admission registration for one job shard: priority class plus
+        group/RPC budgets (last writer wins; raising or unlimiting the
+        group budget re-admits previously evicted groups)."""
+        body: dict = {"job_id": job_id}
+        if priority is not None:
+            body["priority"] = int(priority)
+        if group_budget is not None:
+            body["group_budget"] = int(group_budget)
+        if rpc_budget is not None:
+            body["rpc_budget"] = int(rpc_budget)
+        return self.post(
+            "/torchft.LighthouseService/RegisterJob", body, timeout
+        )
+
+    def epoch_watch(
+        self,
+        replica_id: str,
+        epoch: int,
+        timeout: "float | timedelta" = 10.0,
+        job_id: Optional[str] = None,
+    ) -> "tuple[int, bool]":
+        """Raw lighthouse EpochWatch long-poll on the JOB's membership
+        epoch (bench/test path; managers use ManagerClient.epoch_watch).
+        Returns ``(current_epoch, changed)``."""
+        body: dict = {"replica_id": replica_id, "epoch": int(epoch)}
+        if job_id is not None:
+            body["job_id"] = job_id
+        d = self.post("/torchft.LighthouseService/EpochWatch", body, timeout)
+        return int(d.get("epoch", 0)), bool(d.get("changed", False))
 
     def __del__(self) -> None:
         handle, self._handle = getattr(self, "_handle", None), None

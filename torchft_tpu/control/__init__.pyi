@@ -22,6 +22,7 @@ class QuorumResult:
     heal: bool
     membership_epoch: int
     lease_ms: int
+    evicted: bool
 
 class ManagerClient:
     def __init__(
@@ -66,6 +67,7 @@ class ManagerServer:
         heartbeat_interval: "float | timedelta" = ...,
         connect_timeout: "float | timedelta" = ...,
         exit_on_kill: bool = ...,
+        job_id: str = ...,
     ) -> None: ...
     def address(self) -> str: ...
     def kill_requested(self) -> bool: ...
@@ -87,6 +89,7 @@ class Lighthouse:
         upstream_addr: Optional[str] = ...,
         upstream_report_interval_ms: Optional[int] = ...,
         lease_ms: Optional[int] = ...,
+        fleet_capacity: Optional[int] = ...,
     ) -> None: ...
     def address(self) -> str: ...
     def shutdown(self) -> None: ...
@@ -97,10 +100,33 @@ class LighthouseClient:
         self,
         replica_id: "str | List[str]",
         timeout: "float | timedelta" = ...,
+        job_id: Optional[str] = ...,
     ) -> None: ...
     def quorum(
-        self, requester: dict, timeout: "float | timedelta" = ...
+        self,
+        requester: dict,
+        timeout: "float | timedelta" = ...,
+        job_id: Optional[str] = ...,
+        extra: Optional[dict] = ...,
     ) -> dict: ...
+    def post(
+        self, path: str, body: dict, timeout: "float | timedelta" = ...
+    ) -> dict: ...
+    def register_job(
+        self,
+        job_id: str,
+        priority: Optional[int] = ...,
+        group_budget: Optional[int] = ...,
+        rpc_budget: Optional[int] = ...,
+        timeout: "float | timedelta" = ...,
+    ) -> dict: ...
+    def epoch_watch(
+        self,
+        replica_id: str,
+        epoch: int,
+        timeout: "float | timedelta" = ...,
+        job_id: Optional[str] = ...,
+    ) -> "tuple[int, bool]": ...
 
 def lighthouse_heartbeat(
     lighthouse_addr: str, replica_id: str,

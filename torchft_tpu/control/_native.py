@@ -99,7 +99,7 @@ def _configure(lib: ctypes.CDLL) -> None:
 
     lib.ft_manager_new.argtypes = [
         c_char_p, c_char_p, c_char_p, c_char_p, c_int, c_char_p,
-        c_u64, c_u64, c_u64, c_int, err_p,
+        c_u64, c_u64, c_u64, c_int, c_char_p, err_p,
     ]
     lib.ft_manager_new.restype = c_void_p
     lib.ft_manager_address.argtypes = [c_void_p]
@@ -156,6 +156,12 @@ def _configure(lib: ctypes.CDLL) -> None:
         c_void_p, c_char_p, c_u64, err_p,
     ]
     lib.ft_lighthouse_client_quorum2.restype = c_void_p
+    # Generic lighthouse POST (RegisterJob, raw EpochWatch, ...): the
+    # escape hatch that keeps the ABI stable as control RPCs multiply.
+    lib.ft_lighthouse_client_post.argtypes = [
+        c_void_p, c_char_p, c_char_p, c_u64, err_p,
+    ]
+    lib.ft_lighthouse_client_post.restype = c_void_p
 
     lib.ft_quorum_compute.argtypes = [c_i64, c_char_p, c_char_p, err_p]
     lib.ft_quorum_compute.restype = c_void_p

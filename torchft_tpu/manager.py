@@ -154,6 +154,7 @@ class Manager:
         comm_backend: Optional[str] = None,
         comm_options: Optional[Dict[str, Any]] = None,
         model_shards: int = 1,
+        job_id: str = "default",
     ) -> None:
         # min_replica_size stays effectively REQUIRED even though comm's
         # new default forced a syntactic default onto everything after
@@ -220,6 +221,21 @@ class Manager:
         self._world_size_mode = world_size_mode
         self._min_replica_size = min_replica_size
 
+        # Multi-tenant control plane (PR 19): the job this replica group
+        # belongs to. Rides every lighthouse RPC (the ManagerServer stamps
+        # it) and namespaces the group-store keys so two jobs sharing one
+        # store never collide. "default" (and "") keep the exact pre-PR
+        # key shapes — a single-job fleet is byte-identical on the wire.
+        self._job_id = job_id or "default"
+        self._store_prefix = (
+            "" if self._job_id == "default" else f"job:{self._job_id}/"
+        )
+        # Set when the lighthouse preempts this group's replica out of the
+        # fleet (a prescriptive quorum decision, never a timeout): the
+        # step path sees it as a latched error (no commit), callers poll
+        # is_evicted() to shrink/exit live.
+        self._evicted = False
+
         store_addr = store_addr or (
             f"{os.environ['MASTER_ADDR']}:{os.environ['MASTER_PORT']}"
         )
@@ -265,16 +281,20 @@ class Manager:
                 world_size=world_size,
                 heartbeat_interval=_seconds(heartbeat_interval),
                 connect_timeout=self._connect_timeout,
+                job_id=self._job_id,
             )
-            self._store.set(MANAGER_ADDR_KEY, self._manager.address())
-            self._store.set(REPLICA_ID_KEY, replica_id)
+            self._store.set(
+                self._store_prefix + MANAGER_ADDR_KEY,
+                self._manager.address(),
+            )
+            self._store.set(self._store_prefix + REPLICA_ID_KEY, replica_id)
 
         # Every rank advertises its checkpoint server on the group store so
         # a donor's manifests can carry peer addresses — the multi-host
         # fan-out that lets a healer fetch regions this host's shards
         # don't cover from the rank that owns them.
         self._store.set(
-            f"checkpoint_addr_{self._rank}",
+            f"{self._store_prefix}checkpoint_addr_{self._rank}",
             self._checkpoint_transport.metadata(),
         )
         self._ckpt_fanout = self._world_size > 1 and hasattr(
@@ -282,11 +302,13 @@ class Manager:
         )
 
         addr = self._store.wait(
-            MANAGER_ADDR_KEY, timeout=self._connect_timeout
+            self._store_prefix + MANAGER_ADDR_KEY,
+            timeout=self._connect_timeout,
         ).decode()
         self._client = ManagerClient(addr, connect_timeout=self._connect_timeout)
         replica_id = self._store.wait(
-            REPLICA_ID_KEY, timeout=self._connect_timeout
+            self._store_prefix + REPLICA_ID_KEY,
+            timeout=self._connect_timeout,
         ).decode()
         self._replica_id = replica_id
         self._logger = _ManagerLogger(self, replica_id, self._rank)
@@ -718,6 +740,8 @@ class Manager:
         return {
             "replica_id": self._replica_id,
             "rank": self._rank,
+            "job_id": self._job_id,
+            "evicted": self._evicted,
             "step": self._step,
             "epoch": self._quorum_epoch,
             "comm_backend": self.comm_backend(),
@@ -1022,6 +1046,34 @@ class Manager:
         )
 
     def _finish_quorum(self, quorum, allow_heal: bool) -> None:
+        if getattr(quorum, "evicted", False):
+            # Prescriptive preemption: the lighthouse told us — in the
+            # decision body, not by timeout — that a higher-priority job
+            # claimed our capacity. Surface it as a latched error (this
+            # step discards, the commit barrier votes False) and a
+            # job_preempted event; the driver polls is_evicted() and
+            # shrinks the job live through the redistribution planner.
+            self._evicted = True
+            self._participating_rank = None
+            self._participating_world_size = 0
+            self._break_lease("job_preempted")
+            if self.events:
+                self.events.emit(
+                    "job_preempted", step=self._step,
+                    epoch=getattr(quorum, "membership_epoch", None),
+                    job_id=self._job_id,
+                )
+            self._logger.warn(
+                f"replica evicted from job {self._job_id!r} by "
+                "lighthouse preemption; step will not commit"
+            )
+            self.report_error(
+                RuntimeError(
+                    f"evicted: job {self._job_id!r} preempted by "
+                    "higher-priority job"
+                )
+            )
+            return
         self._quorum_epoch = quorum.quorum_id
         # Async quorum: only the up-to-date (max-step) cohort participates —
         # healing replicas contribute zeros this step. Sync quorum (or
@@ -1175,7 +1227,7 @@ class Manager:
                     try:
                         self._checkpoint_transport.set_peers([
                             self._store.wait(
-                                f"checkpoint_addr_{r}",
+                                f"{self._store_prefix}checkpoint_addr_{r}",
                                 timeout=self._connect_timeout,
                             ).decode()
                             for r in range(self._world_size)
@@ -1468,6 +1520,19 @@ class Manager:
     def num_participants(self) -> int:
         assert self._participating_world_size >= 0, "internal error"
         return self._participating_world_size
+
+    def job_id(self) -> str:
+        """Job this replica group belongs to on the shared lighthouse
+        ("default" for single-tenant fleets — the pre-multijob wire and
+        store-key shapes, byte-identical)."""
+        return self._job_id
+
+    def is_evicted(self) -> bool:
+        """True once the lighthouse preempted this replica out of the
+        fleet (prescriptive decision, carried in the quorum response
+        body). A shrink-capable driver reacts by redistributing state to
+        the survivors and exiting; an evicted replica never commits."""
+        return self._evicted
 
     def did_heal(self) -> bool:
         """True once this step's fetched checkpoint has been applied via

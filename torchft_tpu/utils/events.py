@@ -123,6 +123,7 @@ EVENT_KINDS = (
     "microbatch_recv",
     "stage_rebalance",
     "lease_break",
+    "job_preempted",
 )
 
 _DEFAULT_CAPACITY = 4096
