@@ -280,6 +280,97 @@ def _grow_chaos_phase() -> dict:
     return out
 
 
+def _serve_grow_phase() -> dict:
+    """Serve-side elastic-growth chaos arm (ISSUE 20): a SERVING member
+    joins mid-run while deploys stream and a traffic hammer runs. A
+    3-member cohort adopts v1 from a train-side publisher, grows to 4,
+    then adopts v2 — the layout-transition deploy — with requests in
+    flight the whole time. Oracles are counters, not wall clock:
+    ``serve_dropped == 0`` and ``serve_stale_reads == 0`` across the
+    growth (the drop-free union transition), every member pins
+    ``deploy_bytes_moved == deploy_lower_bound_bytes``, and the joiner's
+    shard arrives entirely through the plan (no full-model fetch:
+    joiner moved bytes < model bytes). Guarded: failures yield an
+    ``error`` field, never a lost artifact. BENCH_SERVE_GROW=0 skips."""
+    import threading
+
+    import numpy as np
+
+    from torchft_tpu.serve import DeployPublisher, ServeCohort
+
+    n_units = int(os.environ.get("BENCH_SERVE_UNITS", "12"))
+    elems = int(os.environ.get("BENCH_SERVE_ELEMS", "4096"))
+    rng = np.random.default_rng(29)
+    leaves = [
+        rng.standard_normal(elems + 64 * i).astype(np.float32)
+        for i in range(n_units)
+    ]
+    unit_bytes = [int(a.nbytes) for a in leaves]
+    total = sum(unit_bytes)
+    out: dict = {"n_units": n_units, "model_bytes": total}
+    pub = DeployPublisher()
+    cohort = None
+    try:
+        _touch("serve_grow")
+        addr1 = pub.publish(1, leaves)
+        cohort = ServeCohort(3, replication=2)
+        cohort.deploy(1, [addr1], unit_bytes)
+
+        stop = threading.Event()
+        answered = [0]
+
+        def hammer() -> None:
+            u = 0
+            while not stop.is_set():
+                cohort.answer(u % n_units, 1.0)
+                answered[0] += 1
+                u += 1
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        joiner = cohort.grow()
+        addr2 = pub.publish(2, [a * 1.01 for a in leaves])
+        moved2 = cohort.deploy(2, [addr2], unit_bytes)
+        stop.set()
+        t.join(timeout=10.0)
+
+        per_member = [m.metrics.snapshot() for m in cohort.members]
+        router = cohort.metrics.snapshot()
+        joiner_moved = per_member[joiner.member_index].get(
+            "deploy_bytes_moved", 0.0
+        )
+        out.update(
+            grown_members=len(cohort.members),
+            requests_answered=answered[0],
+            growth_deploy_moved_bytes=int(moved2),
+            serve_dropped=int(router.get("serve_dropped", 0) or 0),
+            serve_reroutes=int(router.get("serve_reroutes", 0) or 0),
+            serve_stale_reads=int(sum(
+                s.get("serve_stale_reads", 0) or 0 for s in per_member
+            )),
+            minimal=all(
+                s.get("deploy_bytes_moved", 0)
+                == s.get("deploy_lower_bound_bytes", 0)
+                for s in per_member
+            ),
+            joiner_moved_bytes=int(joiner_moved),
+            # THE growth oracle: joining must cost the joiner its SHARD,
+            # never the whole model
+            joiner_sharded=bool(0 < joiner_moved < total),
+            drop_free=(
+                int(router.get("serve_dropped", 0) or 0) == 0
+                and answered[0] > 0
+            ),
+        )
+    except Exception as e:  # noqa: BLE001 — never lose the artifact
+        out["error"] = repr(e)
+    finally:
+        if cohort is not None:
+            cohort.shutdown()
+        pub.close()
+    return out
+
+
 def _sync_algorithms_phase() -> dict:
     """Measured LocalSGD + DiLoCo segments (BASELINE.json configs 3-4).
 
@@ -2173,6 +2264,14 @@ def _run() -> None:
     )
     _PARTIAL["grow"] = grow_phase
 
+    # Serve-side growth (ISSUE 20): a serving member joins mid-run while
+    # deploys stream; drop-free + minimal-bytes oracles gate it.
+    serve_grow_phase = (
+        _serve_grow_phase()
+        if os.environ.get("BENCH_SERVE_GROW", "1") != "0" else None
+    )
+    _PARTIAL["serve_grow"] = serve_grow_phase
+
     flops_step = _flops_per_step(cfg, n_params, seq_len, tokens_per_step)
     if peak_flops is not None:
         mfu = flops_step * steps / t1_elapsed / peak_flops
@@ -2218,6 +2317,7 @@ def _run() -> None:
             ),
             "sharded": sharded_phase,
             "grow": grow_phase,
+            "serve_grow": serve_grow_phase,
             "t1_phase_ms": t1_phase_ms,
             "t1_min_replica_world": t1_min_world,
             "t1_participants_min": min(t1_parts),

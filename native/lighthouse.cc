@@ -914,6 +914,11 @@ Response Lighthouse::handle_status_json() {
         for (const auto& p : q.participants)
           ids.push_back(ftjson::Value(p.replica_id));
         e["quorum_replica_ids"] = ftjson::Value(std::move(ids));
+        // Full installed quorum (participants with address/store_address/
+        // step), same shape as the default job's top-level "quorum": the
+        // fleet poller walks non-default jobs — serving cohorts above all
+        // — to their replicas' telemetry endpoints through exactly this.
+        e["quorum"] = q.to_json();
       }
       jobs[kv.first] = ftjson::Value(std::move(e));
     }

@@ -1237,6 +1237,89 @@ def multijob_smoke() -> "list[str]":
     return failures
 
 
+def serve_smoke() -> "list[str]":
+    """One in-process train→serve adoption round (the ISSUE 20 gate):
+    a DeployPublisher stages two committed versions, a replication-2
+    cohort adopts both through the planner-compiled deploy plane, and
+    inference requests are answered between them. Fails on
+    missing/non-finite ``deploy_*``/``serve_*`` gauges, a per-member
+    byte count off the planner's lower bound (the deploy over-shipped
+    or full-fetched), a member left behind the published version, or
+    ANY dropped / stale-read request."""
+    import math
+
+    import numpy as np
+
+    from torchft_tpu.serve import DeployPublisher, ServeCohort
+
+    failures: "list[str]" = []
+    rng = np.random.default_rng(20)
+    pub = DeployPublisher()
+    cohort = ServeCohort(2, replication=2)
+    try:
+        for version in (1, 2):
+            leaves = [
+                (rng.standard_normal(512 + 32 * i) * version).astype(
+                    np.float32
+                )
+                for i in range(6)
+            ]
+            unit_bytes = [int(a.nbytes) for a in leaves]
+            pre = [
+                (m.metrics.snapshot().get("deploy_bytes_moved", 0.0) or 0.0)
+                for m in cohort.members
+            ]
+            addr = pub.publish(version, leaves)
+            cohort.deploy(version, [addr], unit_bytes)
+            for m, pm in zip(cohort.members, pre):
+                snap = m.metrics.snapshot()
+                moved = (snap.get("deploy_bytes_moved", 0.0) or 0.0) - pm
+                lower = snap.get("deploy_lower_bound_bytes")
+                if moved <= 0 or float(snap.get(
+                        "deploy_bytes_moved") or 0) != float(lower or -1):
+                    failures.append(
+                        f"serve smoke: v{version} member moved {moved} "
+                        f"(cumulative lower bound {lower!r}) — not the "
+                        "planner minimum"
+                    )
+                for key in ("deploy_wall_ms", "serve_version",
+                            "serve_version_lag", "deploy_adoptions"):
+                    v = snap.get(key)
+                    if v is None or not math.isfinite(float(v)) or v < 0:
+                        failures.append(
+                            f"serve smoke: gauge {key!r} missing/"
+                            f"non-finite: {v!r}"
+                        )
+            for u in range(len(leaves)):
+                got_v, val = cohort.answer(u, 1.0)
+                if got_v != version:
+                    failures.append(
+                        f"serve smoke: unit {u} answered at version "
+                        f"{got_v} after deploy of {version}"
+                    )
+                elif not math.isfinite(val):
+                    failures.append(
+                        f"serve smoke: unit {u} answered non-finite {val!r}"
+                    )
+        rsnap = cohort.metrics.snapshot()
+        for key in ("serve_dropped", "serve_stale_reads"):
+            total = float(rsnap.get(key) or 0) + sum(
+                float(m.metrics.snapshot().get(key) or 0)
+                for m in cohort.members
+            )
+            if total != 0:
+                failures.append(
+                    f"serve smoke: {key} = {total} across the round "
+                    "(must be exactly 0)"
+                )
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"serve smoke: round failed: {e!r}")
+    finally:
+        cohort.shutdown()
+        pub.close()
+    return failures
+
+
 def main() -> int:
     env = {
         k: v for k, v in os.environ.items()
@@ -1290,6 +1373,7 @@ def main() -> int:
     failures += pipeline_smoke()
     failures += fastpath_smoke()
     failures += multijob_smoke()
+    failures += serve_smoke()
     for key in ("t1_pipeline_overlap", "t1_pipeline_ms", "t1_ddp_streamed",
                 "t1_overhead_ms", "t1_outer_overlap", "t1_outer_wire_ms",
                 "comm_backend", "t1_events_recorded",
@@ -1348,7 +1432,7 @@ def main() -> int:
         "heal_gauges=ok outer_gauges=ok xla_gauges=ok qpsum_gauges=ok "
         "hier_gauges=ok chrome_trace=ok sharded_gauges=ok "
         "redist_gauges=ok fused_gauges=ok fleet_gauges=ok "
-        "pipe_gauges=ok multijob=ok"
+        "pipe_gauges=ok multijob=ok serve=ok"
     )
     return 0
 

@@ -56,13 +56,27 @@ def discover_managers(
     ``domains`` table (tier-1 aggregator lighthouses reporting upstream),
     each aggregator's own ``/status.json`` is walked too and its quorum
     participants join the discovery set tagged with their domain name —
-    one command still covers the whole fleet."""
+    one command still covers the whole fleet.
+
+    Multi-tenant jobs (PR 19/20): each non-default entry in ``jobs{}``
+    carries its own installed ``quorum``; those participants join the
+    set tagged with the job name. Training tenants resolve through
+    their job-prefixed store keys (``job:<id>/checkpoint_addr_{rank}``);
+    serving replicas (serve.py) advertise their telemetry-serving
+    checkpoint server AS the participant address, so a failed store walk
+    falls back to the address itself — train and serve domains land in
+    one tree from one command."""
     from concurrent.futures import ThreadPoolExecutor
 
     from torchft_tpu.comm.store import StoreClient
 
     status = fetch_json(lighthouse.rstrip("/") + "/status.json", timeout)
     members = list(status.get("quorum", {}).get("participants", []))
+    for jname, j in sorted((status.get("jobs") or {}).items()):
+        if jname == "default":
+            continue  # the top-level quorum above IS the default job's
+        for m in (j.get("quorum") or {}).get("participants", []):
+            members.append(dict(m, job=str(jname)))
     domains = sorted(
         (name, dom["address"])
         for name, dom in (status.get("domains") or {}).items()
@@ -91,30 +105,44 @@ def discover_managers(
                     members.append(dict(m, domain=name))
 
     def _walk(member: Dict[str, Any]) -> List[Dict[str, Any]]:
+        job = member.get("job")
         base = {
             "replica_id": member.get("replica_id", "?"),
             "step": member.get("step"),
             "manager_addr": member.get("address", ""),
             "domain": member.get("domain"),
+            "job": job,
         }
         world = int(member.get("world_size", 1) or 1)
+        prefix = f"job:{job}/" if job else ""
+        store_addr = member.get("store_address", "") or ""
+        if job and store_addr.startswith("http"):
+            # Not a StoreServer (those are raw host:port): a serving
+            # replica advertising its telemetry-serving checkpoint
+            # server in both address fields. Poll it directly.
+            return [dict(base, rank=0, url=member.get("address"))]
         try:
             store = StoreClient(
                 member.get("store_address", ""), connect_timeout=timeout
             )
             out = []
             for rank in range(world):
-                raw = store.get(f"checkpoint_addr_{rank}")
+                raw = store.get(f"{prefix}checkpoint_addr_{rank}")
                 ep = dict(base, rank=rank)
                 if raw:
                     ep["url"] = raw.decode()
                 else:
                     ep["url"] = None
-                    ep["error"] = f"no checkpoint_addr_{rank} in store"
+                    ep["error"] = f"no {prefix}checkpoint_addr_{rank} in store"
                 out.append(ep)
             return out
         except Exception as e:  # noqa: BLE001 — a dead group's store is
-            # expected fleet weather; report the row, keep polling peers
+            # expected fleet weather; report the row, keep polling peers.
+            # A job member with no store at all (serving replicas put
+            # their telemetry-serving checkpoint server in BOTH address
+            # fields) polls the advertised address directly instead.
+            if job and member.get("address"):
+                return [dict(base, rank=0, url=member["address"])]
             return [dict(base, rank=0, url=None, error=repr(e)[:120])]
 
     endpoints: List[Dict[str, Any]] = []
@@ -165,6 +193,8 @@ def build_row(ep: Dict[str, Any],
     replica = str(ep.get("replica_id", "?"))[:24]
     if ep.get("domain"):
         replica = f"{ep['domain']}/{replica}"[:32]
+    if ep.get("job"):
+        replica = f"{ep['job']}/{replica}"[:32]
     row = {
         "replica": replica,
         "rank": ep.get("rank", 0),
@@ -186,6 +216,8 @@ def build_row(ep: Dict[str, Any],
         "d_intra_mb": None,
         "d_inter_mb": None,
         "redist_waste_mb": None,
+        "serve_ver": None,
+        "lag": None,
         "last_event": "-",
         "error": error,
     }
@@ -259,6 +291,15 @@ def build_row(ep: Dict[str, Any],
     lower = m.get("redist_lower_bound_bytes")
     if moved is not None and lower is not None:
         row["redist_waste_mb"] = max(0.0, float(moved) - float(lower)) / 1e6
+    # Train-to-serve plane (ISSUE 20): which weight version this serving
+    # row answers from and how far it trails the newest publish —
+    # lag 0 fleet-wide means every replica flipped; a row stuck at a
+    # positive lag is an adoption that never completed.
+    sv = m.get("serve_version")
+    if sv is not None or tel.get("serve"):
+        row["serve_ver"] = None if sv is None else int(float(sv))
+        slag = m.get("serve_version_lag")
+        row["lag"] = None if slag is None else int(float(slag))
     counters = {
         k: float(m[k])
         for k in ("comm_intra_bytes", "comm_inter_bytes")
@@ -290,6 +331,7 @@ _COLUMNS = (
     ("heal_mb_s", 9), ("ddp_overlap", 11), ("outer_overlap", 13),
     ("stage", 5), ("inflight", 8), ("bubble", 6),
     ("d_intra_mb", 10), ("d_inter_mb", 10), ("redist_waste_mb", 15),
+    ("serve_ver", 9), ("lag", 5),
     ("last_event", 34),
 )
 
@@ -362,6 +404,7 @@ def build_job_rows(
             "prio": j.get("priority", 0),
             "groups": f"{healthy}/{budget if budget > 0 else '∞'}",
             "epoch": j.get("membership_epoch"),
+            "step": j.get("max_step"),
             "q_age_s": None if age_ms is None else age_ms / 1000.0,
             "d_rpc": None,
             "preempt": j.get("preemptions"),
@@ -386,8 +429,8 @@ def build_job_rows(
 
 _JOB_COLUMNS = (
     ("job", 24), ("prio", 5), ("groups", 7), ("epoch", 6),
-    ("q_age_s", 8), ("d_rpc", 6), ("preempt", 8), ("drops", 6),
-    ("evicted", 8),
+    ("step", 6), ("q_age_s", 8), ("d_rpc", 6), ("preempt", 8),
+    ("drops", 6), ("evicted", 8),
 )
 
 

@@ -29,7 +29,11 @@
 #                                  # in-process 2-stage x 4-microbatch
 #                                  # pipeline round per schedule arm with
 #                                  # finite pipe_* gauges and a bitwise
-#                                  # pipelined-vs-stage-serial step
+#                                  # pipelined-vs-stage-serial step,
+#                                  # AND one train->serve adoption round
+#                                  # (serve_smoke: deploy_* bytes pinned
+#                                  # at the planner lower bound, zero
+#                                  # dropped / stale-read requests)
 #                                  # (metric/event regressions fail
 #                                  # loudly instead of vanishing)
 

@@ -1,0 +1,428 @@
+"""Train-to-serve deploy plane tests (ISSUE 20).
+
+Pins the plane's contracts:
+
+- a deploy moves EXACTLY the planner's set-theoretic lower bound per
+  member (never a full-checkpoint re-fetch) and the cohort total is
+  ``replication x`` the model — vs ``members x`` for the naive arm;
+- version-gated fetches: a holder staged at version V answers a
+  request for any other version with an HTTP error, never stale bytes;
+- zero dropped and zero stale-read inference requests across a
+  serving-replica KILL and a CONCURRENT deploy, reconstructed from the
+  cohort's ``/telemetry`` HTTP surface alone (counters + events — the
+  same walk ``fleet_top`` does);
+- a rejoining member heals its serve shard from serve PEERS, not the
+  training job (``deploy_train_bytes`` delta = 0);
+- cohort growth is drop-free (transitional union shards, late router
+  layout swap) and the joiner adopts a SHARD, not the full model;
+- ``Manager.set_commit_hook`` — the train-side publish seam — fires
+  once per committed step and never raises into the step.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torchft_tpu.serve import (
+    DeployPublisher,
+    ServeCohort,
+    ServingReplica,
+    serve_layout,
+    unit_digest,
+)
+
+N_UNITS = 8
+ELEMS = 1024
+
+
+def _leaves(version: int, n_units: int = N_UNITS, elems: int = ELEMS):
+    rng = np.random.default_rng(100 + version)
+    return [
+        rng.standard_normal(elems + 8 * i).astype(np.float32)
+        for i in range(n_units)
+    ]
+
+
+def _telemetry(addr: str, path: str = "metrics") -> dict:
+    with urllib.request.urlopen(
+        f"{addr}/telemetry/{path}?since=0" if path == "events"
+        else f"{addr}/telemetry/{path}", timeout=5
+    ) as resp:
+        return json.load(resp)
+
+
+# ------------------------------------------------------------------- layout
+
+
+def test_serve_layout_replication() -> None:
+    unit_bytes = [int(a.nbytes) for a in _leaves(1)]
+    layout = serve_layout(unit_bytes, 4, replication=2)
+    for u in range(N_UNITS):
+        assert len(set(layout.holders_of(u))) == 2
+    covered = set()
+    for m in range(4):
+        covered |= set(layout.units_of(m))
+    assert covered == set(range(N_UNITS))
+    # replication is clamped to the member count
+    solo = serve_layout(unit_bytes, 1, replication=2)
+    assert set(solo.units_of(0)) == set(range(N_UNITS))
+
+
+# ------------------------------------------------- lower-bound byte counters
+
+
+def test_deploy_moved_pinned_at_lower_bound_vs_naive() -> None:
+    leaves = _leaves(1)
+    unit_bytes = [int(a.nbytes) for a in leaves]
+    model_bytes = sum(unit_bytes)
+    pub = DeployPublisher()
+    cohort = ServeCohort(4, replication=2)
+    try:
+        addr = pub.publish(1, leaves)
+        moved = cohort.deploy(1, [addr], unit_bytes)
+        # per member: moved == the planner's lower bound, exactly
+        for m in cohort.members:
+            snap = m.metrics.snapshot()
+            assert snap["deploy_bytes_moved"] == snap[
+                "deploy_lower_bound_bytes"
+            ], snap
+            assert snap["deploy_bytes_moved"] > 0
+        # cohort-wide: replication x model — the sharded deploy price
+        assert moved == 2 * model_bytes
+        # the naive full-fetch arm costs members x model: >= 2x waste
+        naive = 4 * model_bytes
+        assert naive / moved >= 2.0
+        # digest oracle: every member's live units match the publisher
+        digests = pub.digests(1)
+        for m in cohort.members:
+            live = m._live
+            assert live is not None and live.version == 1
+            for u, d in live.digests.items():
+                assert d == digests[u]
+                assert unit_digest(live.buffers[u]) == d
+    finally:
+        cohort.shutdown()
+        pub.close()
+
+
+def test_version_gate_rejects_wrong_version() -> None:
+    # Holders stage a payload AT a version; a fetch for any other
+    # version is an HTTP error — stale bytes are structurally
+    # impossible, which is what lets `serve_stale_reads` pin at 0.
+    from torchft_tpu.checkpointing import RedistFetcher
+
+    leaves = _leaves(2)
+    pub = DeployPublisher()
+    try:
+        addr = pub.publish(2, leaves)
+        good = RedistFetcher(5.0, step=2)
+        try:
+            got = good.fetch(addr, 0)
+            assert b"".join(
+                a.tobytes() for a in got
+            ) == leaves[0].tobytes()
+        finally:
+            good.close()
+        wrong = RedistFetcher(5.0, step=7)
+        try:
+            with pytest.raises(Exception) as ei:
+                wrong.fetch(addr, 0)
+            assert not isinstance(ei.value, AssertionError)
+        finally:
+            wrong.close()
+    finally:
+        pub.close()
+
+
+# ------------------------------------- kill + concurrent deploy, zero loss
+
+
+def test_kill_and_concurrent_deploy_zero_drop_zero_stale() -> None:
+    # The acceptance e2e: requests hammer the router while a member is
+    # killed AND a new version deploys. Every oracle below reads the
+    # cohort's /telemetry HTTP surface (the fleet_top walk) — no
+    # in-process state.
+    from torchft_tpu.control import Lighthouse
+
+    lh = Lighthouse(
+        min_replicas=1, join_timeout_ms=100, quorum_tick_ms=10,
+        heartbeat_timeout_ms=1200, lease_ms=2000,
+    )
+    leaves1, leaves2 = _leaves(1), _leaves(2)
+    unit_bytes = [int(a.nbytes) for a in leaves1]
+    pub = DeployPublisher()
+    cohort = ServeCohort(
+        3, lighthouse_addr=lh.address(), replication=2,
+        heartbeat_interval=0.1,
+    )
+    stop = threading.Event()
+    local_drops = [0]
+    answered = [0]
+
+    def _hammer() -> None:
+        u = 0
+        while not stop.is_set():
+            try:
+                cohort.answer(u % N_UNITS, 1.0)
+                answered[0] += 1
+            except ConnectionError:
+                local_drops[0] += 1
+            u += 1
+
+    try:
+        cohort.deploy(1, [pub.publish(1, leaves1)], unit_bytes)
+        t = threading.Thread(target=_hammer, daemon=True)
+        t.start()
+        time.sleep(0.15)
+        victim = cohort.members[1]
+        victim.kill()  # mid-traffic
+        addr2 = pub.publish(2, leaves2)
+        cohort.deploy(2, [addr2], unit_bytes)  # concurrent with traffic
+        time.sleep(0.15)
+        stop.set()
+        t.join(timeout=5.0)
+
+        assert answered[0] > 0
+        assert local_drops[0] == 0  # the caller-side half of the claim
+
+        # --- telemetry-only reconstruction -------------------------
+        router = _telemetry(cohort.router_address())
+        members = [
+            _telemetry(m.address) for m in cohort.members if m.alive
+        ]
+        dropped = float(router["metrics"].get("serve_dropped") or 0)
+        stale = sum(
+            float(t["metrics"].get("serve_stale_reads") or 0)
+            for t in members
+        )
+        assert dropped == 0.0, router["metrics"]
+        assert stale == 0.0, [t["metrics"] for t in members]
+        # the kill was really exercised: the router re-routed
+        assert float(router["metrics"].get("serve_reroutes") or 0) > 0
+        ev = _telemetry(cohort.router_address(), "events")
+        kinds = [e["kind"] for e in ev["events"]]
+        assert "serve_reroute" in kinds
+        # every survivor flipped to v2, and each member-level
+        # deploy_done carries moved == lower (the counter pin, read
+        # back from the event stream)
+        for m, tel in zip(
+            [m for m in cohort.members if m.alive], members
+        ):
+            assert tel["step"] == 2, tel  # live version via telemetry
+            mev = _telemetry(m.address, "events")
+            dones = [
+                e for e in mev["events"] if e["kind"] == "deploy_done"
+            ]
+            assert dones
+            for e in dones:
+                assert e["moved_bytes"] == e["lower_bound_bytes"]
+            assert any(
+                e["kind"] == "serve_flip" and e["step"] == 2
+                for e in mev["events"]
+            )
+    finally:
+        stop.set()
+        cohort.shutdown()
+        pub.close()
+        lh.shutdown()
+
+
+# ------------------------------------------------------ rejoin from peers
+
+
+def test_rejoin_heals_from_serve_peers_not_training_job() -> None:
+    leaves = _leaves(3)
+    unit_bytes = [int(a.nbytes) for a in leaves]
+    pub = DeployPublisher()
+    cohort = ServeCohort(3, replication=2)
+    try:
+        cohort.deploy(3, [pub.publish(3, leaves)], unit_bytes)
+        victim = cohort.members[0]
+        before = victim.metrics.snapshot()
+        victim.kill()
+        assert not victim.alive
+        moved = cohort.rejoin_member(0)
+        after = victim.metrics.snapshot()
+        # healed entirely from serve peers: the training job moved 0
+        train_delta = (after.get("deploy_train_bytes") or 0) - (
+            before.get("deploy_train_bytes") or 0
+        )
+        peer_delta = (after.get("deploy_peer_bytes") or 0) - (
+            before.get("deploy_peer_bytes") or 0
+        )
+        assert train_delta == 0.0, (before, after)
+        assert peer_delta == moved > 0
+        # still planner-minimal, and back at the cohort version
+        assert after["deploy_bytes_moved"] == after[
+            "deploy_lower_bound_bytes"
+        ]
+        assert victim.version == 3
+        # it answers again, and the router routes to it
+        for u in cohort.layout.units_of(0):
+            v, _ = cohort.answer(u, 1.0)
+            assert v == 3
+        ev = victim.events.dump()["events"]
+        join = [e for e in ev if e["kind"] == "serve_join"]
+        assert join and join[-1]["healed_from"]
+    finally:
+        cohort.shutdown()
+        pub.close()
+
+
+# -------------------------------------------------------------- growth
+
+
+def test_growth_transition_is_drop_free_and_sharded() -> None:
+    leaves1, leaves2 = _leaves(4), _leaves(5)
+    unit_bytes = [int(a.nbytes) for a in leaves1]
+    model_bytes = sum(unit_bytes)
+    pub = DeployPublisher()
+    cohort = ServeCohort(3, replication=2)
+    stop = threading.Event()
+    drops = [0]
+    answered = [0]
+
+    def _hammer() -> None:
+        u = 0
+        while not stop.is_set():
+            try:
+                cohort.answer(u % N_UNITS, 1.0)
+                answered[0] += 1
+            except ConnectionError:
+                drops[0] += 1
+            u += 1
+
+    try:
+        cohort.deploy(4, [pub.publish(4, leaves1)], unit_bytes)
+        t = threading.Thread(target=_hammer, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        joiner = cohort.grow()
+        pre = joiner.metrics.snapshot()
+        assert not pre.get("serve_requests")  # not routed to yet
+        cohort.deploy(5, [pub.publish(5, leaves2)], unit_bytes)
+        time.sleep(0.1)
+        stop.set()
+        t.join(timeout=5.0)
+
+        assert answered[0] > 0 and drops[0] == 0
+        assert float(
+            cohort.metrics.snapshot().get("serve_dropped") or 0
+        ) == 0.0
+        assert sum(
+            float(m.metrics.snapshot().get("serve_stale_reads") or 0)
+            for m in cohort.members
+        ) == 0.0
+        # the joiner adopted a SHARD of v5, planner-minimal — never the
+        # full model
+        snap = joiner.metrics.snapshot()
+        assert 0 < snap["deploy_bytes_moved"] < model_bytes
+        assert snap["deploy_bytes_moved"] == snap[
+            "deploy_lower_bound_bytes"
+        ]
+        assert joiner.version == 5
+        # post-transition the router routes by the 4-member layout and
+        # the joiner answers its units
+        assert cohort.layout is not None
+        for u in cohort.layout.units_of(joiner.member_index):
+            v, _ = cohort.answer(u, 1.0)
+            assert v == 5
+    finally:
+        stop.set()
+        cohort.shutdown()
+        pub.close()
+
+
+# ----------------------------------------------------- the train-side seam
+
+
+def test_manager_commit_hook_fires_per_committed_step() -> None:
+    from torchft_tpu.comm.store import StoreServer
+    from torchft_tpu.control import Lighthouse
+    from torchft_tpu.manager import Manager
+
+    lh = Lighthouse(min_replicas=1, join_timeout_ms=100)
+    store = StoreServer()
+    manager = None
+    calls = []
+    try:
+        manager = Manager(
+            min_replica_size=1,
+            timeout=20.0, quorum_timeout=20.0, connect_timeout=20.0,
+            rank=0, world_size=1,
+            store_addr=store.addr,
+            lighthouse_addr=lh.address(),
+            replica_id="serve_hook_test_",
+            heartbeat_interval=0.05,
+        )
+        manager.set_commit_hook(
+            lambda step, parts: calls.append((step, parts))
+        )
+        for _ in range(3):
+            manager.start_quorum(allow_heal=False)
+            manager.allreduce_arrays(
+                [np.ones(4, np.float32)]
+            ).future().result(timeout=20)
+            assert manager.should_commit()
+        assert [s for s, _ in calls] == sorted(
+            {s for s, _ in calls}
+        ) and len(calls) == 3
+        assert all(p >= 1 for _, p in calls)
+        # a hook that raises must not poison the step
+        manager.set_commit_hook(
+            lambda step, parts: (_ for _ in ()).throw(
+                RuntimeError("publish exploded")
+            )
+        )
+        manager.start_quorum(allow_heal=False)
+        manager.allreduce_arrays(
+            [np.ones(4, np.float32)]
+        ).future().result(timeout=20)
+        assert manager.should_commit()
+    finally:
+        if manager is not None:
+            manager.shutdown(wait=False)
+        store.shutdown()
+        lh.shutdown()
+
+
+# ------------------------------------------------- replica-level invariants
+
+
+def test_answer_paths_raise_prescriptively() -> None:
+    r = ServingReplica(0)
+    try:
+        with pytest.raises(ConnectionError):  # nothing adopted yet
+            r.answer(0, 1.0)
+        r.kill()
+        with pytest.raises(ConnectionError):
+            r.answer(0, 1.0)
+        with pytest.raises(ConnectionError):
+            r.address
+    finally:
+        r.shutdown()
+
+
+def test_failed_adopt_latches_old_version() -> None:
+    # Whole-or-latch: an adoption whose donors cannot source the shard
+    # raises BEFORE any fetch and the old version keeps serving.
+    leaves = _leaves(6)
+    unit_bytes = [int(a.nbytes) for a in leaves]
+    pub = DeployPublisher()
+    cohort = ServeCohort(2, replication=2)
+    try:
+        cohort.deploy(6, [pub.publish(6, leaves)], unit_bytes)
+        m = cohort.members[0]
+        with pytest.raises(ConnectionError, match="no holder"):
+            m.adopt(7, cohort.layout, unit_bytes, donor_addrs=())
+        assert m.version == 6  # latched
+        v, _ = m.answer(next(iter(cohort.layout.units_of(0))), 1.0)
+        assert v == 6
+        assert m.metrics.snapshot().get("serve_stale_reads", 0) == 0
+    finally:
+        cohort.shutdown()
+        pub.close()

@@ -68,6 +68,12 @@ from torchft_tpu.pipeline import (  # noqa: F401
     Pipeline,
     PipelineConfig,
 )
+from torchft_tpu.serve import (  # noqa: F401
+    DeployPublisher,
+    ServeCohort,
+    ServingReplica,
+    serve_layout,
+)
 
 __all__ = [
     "AsyncCheckpointWriter",
@@ -75,6 +81,7 @@ __all__ = [
     "CheckpointServer",
     "CheckpointTransport",
     "CommContext",
+    "DeployPublisher",
     "DiLoCo",
     "DistributedDataParallel",
     "DistributedSampler",
@@ -88,9 +95,12 @@ __all__ = [
     "Pipeline",
     "PipelineConfig",
     "PureDistributedDataParallel",
+    "ServeCohort",
+    "ServingReplica",
     "ShardedGradReducer",
     "ShardedOptimizerWrapper",
     "ShardedOptState",
+    "serve_layout",
     "load_checkpoint",
     "ReduceOp",
     "SubprocessCommContext",

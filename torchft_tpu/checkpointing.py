@@ -56,8 +56,10 @@ from __future__ import annotations
 import http.client
 import io
 import logging
+import os
 import pickle
 import socket
+import struct
 import threading
 import time
 import urllib.error
@@ -85,6 +87,7 @@ from torchft_tpu.comm.wire import (
     tensor_wire_view,
 )
 from torchft_tpu.futures import FutureGroup, StealableTask, future_chain
+from torchft_tpu.utils.crc32c import crc32c
 from torchft_tpu.utils.profiling import throughput_span, timed_span
 from torchft_tpu.utils.serialization import pytree_from_stream, pytree_to_stream
 
@@ -93,6 +96,7 @@ logger = logging.getLogger(__name__)
 T = TypeVar("T")
 
 __all__ = [
+    "ChecksumError",
     "CheckpointTransport",
     "CheckpointServer",
     "RedistFetcher",
@@ -104,6 +108,7 @@ __all__ = [
     "redistribute_exchange",
     "serve_copy_stats",
     "serve_redist_payload",
+    "wire_crc_stats",
 ]
 
 # Chunk size for streaming a staged leaf's byte view into the socket:
@@ -115,6 +120,51 @@ _SEND_CHUNK = 1 << 20
 _WIRE_COMPRESSIBLE = (np.dtype(np.float32), np.dtype(np.float64))
 
 _WIRE_DTYPES = {"bf16": bf16_wire_dtype}
+
+# CRC32C integrity frames on the raw tensor wire (utils/crc32c.py): each
+# tensor body carries a 4-byte little-endian trailer the receiver
+# verifies before the bytes are trusted — a flipped bit that previously
+# landed silently now raises a prescriptive retryable error and the
+# striped/failover machinery refetches the SAME bounds from a healthy
+# peer. Default ON (the frame costs 4 bytes + one linear pass);
+# TORCHFT_TPU_WIRE_CRC=0 is the escape hatch for mixed-version fleets.
+_WIRE_CRC = os.environ.get("TORCHFT_TPU_WIRE_CRC", "1") != "0"
+
+
+class ChecksumError(ConnectionError):
+    """A tensor body failed its CRC32C wire frame — the payload was
+    corrupted in flight (or by a torn donor buffer). Subclasses
+    ConnectionError so every failover site already treats it as
+    "this copy is bad, refetch from a peer"."""
+
+
+# Test seam (like CheckpointServer._stage_hook): a callable mapping an
+# outgoing chunk to what actually hits the socket, applied AFTER the
+# frame checksum accumulated the true bytes — the only way to simulate
+# corruption-in-flight, which by definition happens downstream of the
+# donor's CRC.
+_WIRE_FAULT_HOOK = None
+
+_crc_stats_lock = threading.Lock()
+_crc_stats = {"frames_checked": 0, "checksum_errors": 0}
+
+
+def wire_crc_stats(reset: bool = False) -> Dict[str, int]:
+    """Snapshot (optionally reset) the receiver-side CRC frame counters
+    (test hook, like :func:`serve_copy_stats`)."""
+    with _crc_stats_lock:
+        out = dict(_crc_stats)
+        if reset:
+            for k in _crc_stats:
+                _crc_stats[k] = 0
+    return out
+
+
+def _count_crc(ok: bool) -> None:
+    with _crc_stats_lock:
+        _crc_stats["frames_checked"] += 1
+        if not ok:
+            _crc_stats["checksum_errors"] += 1
 
 
 # ------------------------------------------------------------- copy counting
@@ -541,13 +591,16 @@ class _Handler(BaseHTTPRequestHandler):
             return staged
 
     def _send_tensor(self, arr: np.ndarray, dtype: np.dtype,
-                     wire_dtype: "Optional[np.dtype]") -> None:
+                     wire_dtype: "Optional[np.dtype]",
+                     crc: bool = False) -> None:
         """Stream one tensor region: headers + chunked writes of a byte
         view over the (staged) array — no tobytes, no body
         materialization. ``dtype`` is the staged dtype; ``wire_dtype``
         (when set and the leaf is wire-compressible) downcasts on the
         way out, which inherently allocates — it is the opt-in lossy
-        lever, never the default."""
+        lever, never the default. ``crc`` appends the 4-byte CRC32C
+        trailer (requested via ``?crc=1``; Content-Length includes
+        it)."""
         view, wired = _wire_encode(arr, wire_dtype)
         self.send_response(200)
         self.send_header("X-Kind", "ndarray")
@@ -557,11 +610,21 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header(
             "X-Shape", ",".join(str(d) for d in arr.shape)
         )
-        self.send_header("Content-Length", str(view.nbytes))
+        self.send_header(
+            "Content-Length", str(view.nbytes + (4 if crc else 0))
+        )
         self.end_headers()
         self._body_streaming = True
+        c = 0
         for off in range(0, view.nbytes, _SEND_CHUNK):
-            self.wfile.write(view[off: off + _SEND_CHUNK])
+            chunk = view[off: off + _SEND_CHUNK]
+            if crc:
+                c = crc32c(chunk, c)
+            if _WIRE_FAULT_HOOK is not None:
+                chunk = _WIRE_FAULT_HOOK(chunk)
+            self.wfile.write(chunk)
+        if crc:
+            self.wfile.write(struct.pack("<I", c))
         self._body_streaming = False
 
     def _send_json(self, obj: dict) -> None:
@@ -711,6 +774,7 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 q = parse_qs(url.query)
                 wire = q.get("wire", [None])[0]
+                crc = q.get("crc", ["0"])[0] == "1"
                 if wire is not None and wire not in _WIRE_DTYPES:
                     self.send_error(400, f"unknown wire dtype {wire!r}")
                     return
@@ -727,10 +791,15 @@ class _Handler(BaseHTTPRequestHandler):
                         )
                         return
                     sizes.append(_entry_wire_nbytes(entry, wire_dtype))
+                # per-leaf CRC trailers ride INSIDE the body (after each
+                # leaf's bytes) because the leaves stage just-in-time —
+                # their checksums cannot exist at header time, and the
+                # Content-Length must stay metadata-derivable: + 4/leaf.
+                clen = sum(sizes) + (4 * (hi - lo) if crc else 0)
                 self.send_response(200)
                 self.send_header("X-Kind", "rawleaves")
                 self.send_header("X-Count", str(hi - lo))
-                self.send_header("Content-Length", str(sum(sizes)))
+                self.send_header("Content-Length", str(clen))
                 self.end_headers()
                 self._body_streaming = True
                 server_timeout = server._timeout
@@ -741,8 +810,16 @@ class _Handler(BaseHTTPRequestHandler):
                         if isinstance(leaf, _ShardedLeaf) else leaf
                     )
                     view, _ = _wire_encode(arr, wire_dtype)
+                    c = 0
                     for off in range(0, view.nbytes, _SEND_CHUNK):
-                        self.wfile.write(view[off: off + _SEND_CHUNK])
+                        chunk = view[off: off + _SEND_CHUNK]
+                        if crc:
+                            c = crc32c(chunk, c)
+                        if _WIRE_FAULT_HOOK is not None:
+                            chunk = _WIRE_FAULT_HOOK(chunk)
+                        self.wfile.write(chunk)
+                    if crc:
+                        self.wfile.write(struct.pack("<I", c))
                 self._body_streaming = False
                 return
 
@@ -769,6 +846,7 @@ class _Handler(BaseHTTPRequestHandler):
                 q = parse_qs(url.query)
                 spec = q.get("slice", [None])[0]
                 wire = q.get("wire", [None])[0]
+                crc = q.get("crc", ["0"])[0] == "1"
                 if wire is not None and wire not in _WIRE_DTYPES:
                     self.send_error(
                         400,
@@ -794,7 +872,7 @@ class _Handler(BaseHTTPRequestHandler):
                     arr = leaf[_parse_slice_spec(spec, leaf.shape)]
                 else:
                     arr = leaf
-                self._send_tensor(arr, dtype, wire_dtype)
+                self._send_tensor(arr, dtype, wire_dtype, crc=crc)
                 return
 
             self.send_error(404, "unknown path")
@@ -1159,17 +1237,44 @@ def fetch_manifest(metadata: str, step: int, timeout: float = 60.0,
 
 def _read_wire_tensor(resp, dtype: np.dtype, shape: tuple,
                       wire_np: np.dtype, what: str,
-                      out: "Optional[np.ndarray]" = None) -> np.ndarray:
+                      out: "Optional[np.ndarray]" = None,
+                      check_crc: bool = False) -> np.ndarray:
     """Land one tensor body from ``resp``: readinto a preallocated (or
     fresh) array in the staged dtype, via a wire-dtype temporary + upcast
     when the opt-in lossy encoding is active. The single implementation
-    behind BOTH fetch_leaf and the rawleaves range reader."""
+    behind BOTH fetch_leaf and the rawleaves range reader.
+
+    ``check_crc``: the body carries a 4-byte CRC32C trailer (the donor
+    was asked with ``?crc=1``); it is read and verified against the WIRE
+    bytes before they are trusted — a mismatch raises
+    :class:`ChecksumError` (a ConnectionError: every failover site
+    already retries it from a peer) and increments the receiver-side
+    frame counters."""
     if wire_np == dtype:
-        target = out if out is not None else np.empty(shape, dtype)
-        readinto_exact(resp, as_bytes_view(target), what=what)
-        return target
-    wire_arr = np.empty(shape, wire_np)
-    readinto_exact(resp, as_bytes_view(wire_arr), what=what)
+        wire_arr = out if out is not None else np.empty(shape, dtype)
+        readinto_exact(resp, as_bytes_view(wire_arr), what=what)
+        result = wire_arr
+    else:
+        wire_arr = np.empty(shape, wire_np)
+        readinto_exact(resp, as_bytes_view(wire_arr), what=what)
+        result = None  # upcast AFTER the frame check: corrupt bytes
+        # must never be written into a caller's buffer
+    if check_crc:
+        trailer = bytearray(4)
+        readinto_exact(
+            resp, memoryview(trailer), what=f"{what} crc frame"
+        )
+        want = struct.unpack("<I", trailer)[0]
+        got = crc32c(as_bytes_view(wire_arr))
+        _count_crc(got == want)
+        if got != want:
+            raise ChecksumError(
+                f"{what}: CRC32C mismatch (wire frame {want:#010x}, "
+                f"computed {got:#010x}) — payload corrupted in flight; "
+                "refetch from a peer"
+            )
+    if result is not None:
+        return result
     if out is not None:
         out[...] = wire_arr.astype(dtype)
         return out
@@ -1178,13 +1283,16 @@ def _read_wire_tensor(resp, dtype: np.dtype, shape: tuple,
 
 def _leaf_path(step: int, index: int,
                slices: "Optional[Sequence[slice]]",
-               wire_dtype: "Optional[str]") -> str:
+               wire_dtype: "Optional[str]",
+               crc: bool = False) -> str:
     path = f"/checkpoint/{step}/leaf/{index}"
     params = []
     if slices is not None:
         params.append("slice=" + format_slice_spec(slices))
     if wire_dtype is not None:
         params.append(f"wire={wire_dtype}")
+    if crc:
+        params.append("crc=1")
     return path + ("?" + "&".join(params) if params else "")
 
 
@@ -1197,6 +1305,7 @@ def fetch_leaf(
     out: "Optional[np.ndarray]" = None,
     wire_dtype: "Optional[str]" = None,
     conn: "Optional[_DonorConn]" = None,
+    crc: "Optional[bool]" = None,
 ) -> Any:
     """Fetch one leaf (optionally a server-sliced shard of it) by index.
 
@@ -1207,12 +1316,19 @@ def fetch_leaf(
     match); the body is ``readinto`` it with no intermediate bytes.
     ``wire_dtype``: request the opt-in lossy wire encoding ("bf16");
     the result is upcast back to the staged dtype. ``conn``: reuse a
-    keep-alive donor connection (callers doing many fetches)."""
+    keep-alive donor connection (callers doing many fetches).
+    ``crc``: request + verify the CRC32C integrity frame (default: the
+    process-wide ``TORCHFT_TPU_WIRE_CRC`` policy; objects are exempt —
+    the frame covers raw tensor bytes)."""
+    if crc is None:
+        crc = _WIRE_CRC
     own_conn = conn is None
     if own_conn:
         conn = _DonorConn(metadata, timeout)
     try:
-        resp = conn.get(_leaf_path(step, index, slices, wire_dtype))
+        resp = conn.get(
+            _leaf_path(step, index, slices, wire_dtype, crc=crc)
+        )
         kind = resp.headers.get("X-Kind", "ndarray")
         clen_hdr = resp.headers.get("Content-Length")
         if clen_hdr is None:
@@ -1237,6 +1353,7 @@ def fetch_leaf(
         wire_hdr = resp.headers.get("X-Wire-Dtype")
         wire_dt = _dtype_from_str(wire_hdr) if wire_hdr else dtype
         expect = int(np.prod(shape, dtype=np.int64)) * wire_dt.itemsize
+        expect += 4 if crc else 0  # the CRC32C trailer rides the body
         if clen != expect:
             raise ConnectionError(
                 f"leaf {index}: advertised Content-Length {clen} != "
@@ -1255,7 +1372,8 @@ def fetch_leaf(
                     "out buffer must be C-contiguous for recv-into"
                 )
         return _read_wire_tensor(
-            resp, dtype, shape, wire_dt, f"leaf {index} body", out=out
+            resp, dtype, shape, wire_dt, f"leaf {index} body", out=out,
+            check_crc=crc,
         )
     finally:
         if own_conn:
@@ -1595,6 +1713,12 @@ def recv_checkpoint_sharded(
         except urllib.error.HTTPError:
             raise  # donor answered: a protocol error, not a death
         except _NET_ERRORS as first:
+            if isinstance(first, ChecksumError) and metrics is not None:
+                # corrupt payload, not a dead donor — but the
+                # prescription is the same: this copy is bad, refetch
+                # the SAME bounds from a peer (the host is excluded
+                # below like any dead donor for this heal)
+                metrics.incr("heal_checksum_errors")
             with dead_lock:
                 dead_hosts.add(host)
             # a donor death is exactly when the peer manifests become
@@ -1610,7 +1734,10 @@ def recv_checkpoint_sharded(
                 )
                 try:
                     return _fetch_once(alt, i, fetch_bounds, out)
-                except _NET_ERRORS:
+                except _NET_ERRORS as again:
+                    if (isinstance(again, ChecksumError)
+                            and metrics is not None):
+                        metrics.incr("heal_checksum_errors")
                     with dead_lock:
                         dead_hosts.add(alt)
             raise ConnectionError(
@@ -1992,12 +2119,15 @@ def _pool_fetch_leaves(
 
 def serve_redist_payload(
     units: "Dict[int, Sequence[Any]]", timeout: float = 60.0,
+    step: int = _REDIST_STEP,
 ) -> "tuple[str, Any]":
     """Publish a holder's redistribution payload: one ephemeral
     checkpoint server staging ``{"units": {str(u): [arrays...]}}`` at
-    the fixed redist step. Arrays may be DEVICE arrays — the server's
-    lazy per-leaf staging defers any device-to-host copy until a
-    receiver actually fetches that unit (host ndarrays are snapshot
+    the redist step (``step``: ephemeral exchanges keep the fixed
+    default; the serve plane passes the model version so adoption
+    fetches are version-gated). Arrays may be DEVICE arrays — the
+    server's lazy per-leaf staging defers any device-to-host copy until
+    a receiver actually fetches that unit (host ndarrays are snapshot
     eagerly, which is what makes the close-side drain safe). Returns
     ``(address, close)``; ``close()`` drains residual staging and
     tears the server down. The ``serve_fn`` hook of
@@ -2009,7 +2139,7 @@ def serve_redist_payload(
             for u, arrays in units.items()
         }
     }
-    srv.allow_checkpoint(_REDIST_STEP, tree)
+    srv.allow_checkpoint(int(step), tree)
 
     def _close() -> None:
         try:
@@ -2026,12 +2156,21 @@ class RedistFetcher:
     plane. ``fetch(address, unit)`` returns the unit's arrays in slot
     order; holder death surfaces as ``ConnectionError``/``OSError`` so
     the engine's failover can reroute. The ``fetch_factory`` hook of
-    ``comm.redistribute.exchange``."""
+    ``comm.redistribute.exchange``.
 
-    def __init__(self, timeout: float = 60.0) -> None:
+    ``step``: the checkpoint step the holders staged their payload at.
+    Ephemeral reshard exchanges use the fixed ``_REDIST_STEP``; the
+    serve plane's deploy adoptions pass the MODEL VERSION here, which
+    makes every fetch version-gated for free — a holder still staging
+    (or already past) that version answers 400/503, never stale
+    bytes."""
+
+    def __init__(self, timeout: float = 60.0,
+                 step: int = _REDIST_STEP) -> None:
         import re as _re
 
         self._timeout = float(timeout)
+        self._step = int(step)
         self._pool = _ConnPool(self._timeout)
         self._pat = _re.compile(_REDIST_PATH_RE)
         self._slots: "Dict[str, Dict[int, List[int]]]" = {}
@@ -2043,7 +2182,7 @@ class RedistFetcher:
         if cached is not None:
             return cached
         manifest = fetch_manifest(
-            addr, _REDIST_STEP, timeout=self._timeout
+            addr, self._step, timeout=self._timeout
         )
         by_unit: "Dict[int, Dict[int, int]]" = {}
         for mi, entry in enumerate(manifest["leaves"]):
@@ -2074,7 +2213,7 @@ class RedistFetcher:
                 "published spec and the plan diverged"
             )
         return _pool_fetch_leaves(
-            self._pool, addr, _REDIST_STEP, slots[int(unit)],
+            self._pool, addr, self._step, slots[int(unit)],
             self._timeout, what=f"unit {unit}",
         )
 
@@ -2234,9 +2373,15 @@ def _recv_chunked(
             _fetch_range_inner(lo, hi, nb)
 
     def _fetch_range_inner(lo: int, hi: int, nb: list) -> None:
-        path = f"/checkpoint/{step}/rawleaves/{lo}-{hi}"
+        use_crc = _WIRE_CRC
+        params = []
         if wire_dtype is not None:
-            path += f"?wire={wire_dtype}"
+            params.append(f"wire={wire_dtype}")
+        if use_crc:
+            params.append("crc=1")
+        path = f"/checkpoint/{step}/rawleaves/{lo}-{hi}"
+        if params:
+            path += "?" + "&".join(params)
         conn = conn_pool.acquire(metadata)
         try:
             resp = conn.get(path)
@@ -2253,13 +2398,16 @@ def _recv_chunked(
                     else dtype
                 )
                 outs[i] = _read_wire_tensor(
-                    resp, dtype, shape, wire_np, f"leaf {i} body"
+                    resp, dtype, shape, wire_np, f"leaf {i} body",
+                    check_crc=use_crc,
                 )
                 # count WIRE bytes (the downcast payload under the
-                # opt-in lossy encoding, not the upcast copy)
+                # opt-in lossy encoding, not the upcast copy; the
+                # 4-byte CRC frame rides the body for length
+                # accounting but is not payload)
                 wire_nb = _entry_wire_nbytes(entry, (
                     wire_np if wire_np != dtype else None
-                ))
+                )) + (4 if use_crc else 0)
                 got += wire_nb
                 with total_lock:
                     total[0] += wire_nb

@@ -354,6 +354,7 @@ def execute_fetches(
     receiver: int,
     fetch_unit: "Callable[[int, int], List[np.ndarray]]",
     parallel: int = 4,
+    on_fetch: "Optional[Callable[[int, int, int], None]]" = None,
 ) -> "Tuple[Dict[int, List[np.ndarray]], int]":
     """Run receiver ``r``'s slice of the plan: every assigned fetch,
     striped across primaries, with dead-donor failover.
@@ -368,6 +369,12 @@ def execute_fetches(
     the whole call raises :class:`RedistTransferError` — the plan
     completes whole or raises, never partial-adopts (the caller must
     discard the returned dict on exception; none escapes).
+
+    ``on_fetch(unit, holder, nbytes)``: per-unit attribution callback
+    fired after each SUCCESSFUL fetch with the holder that actually
+    served it (failovers included) — the serve plane splits its
+    deploy-bytes counters by source class (train donor vs serve peer)
+    with this.
 
     Returns ``({unit: arrays}, fetched_bytes)``."""
     import urllib.error
@@ -404,6 +411,8 @@ def execute_fetches(
             with out_lock:
                 out[unit] = arrays
                 total[0] += nb
+            if on_fetch is not None:
+                on_fetch(unit, h, nb)
             return
         raise RedistTransferError(
             f"redistribution unit {unit}: every covering holder "

@@ -352,6 +352,7 @@ class Manager:
         self._healing = False
         self._pending_work: List[Future] = []
         self._batches_committed = 0
+        self._commit_hook: "Optional[Callable[[int, int], None]]" = None
 
         self._participating_rank: Optional[int] = None
         self._participating_world_size: int = 0
@@ -1356,6 +1357,31 @@ class Manager:
         (ref manager.py:545-598). True ⇒ the optimizer may be stepped."""
         return self.should_commit_async(timeout=timeout).result()
 
+    def set_commit_hook(
+        self, hook: "Optional[Callable[[int, int], None]]"
+    ) -> None:
+        """Register ``hook(step, num_participants)`` to fire after every
+        COMMITTED step — fastpath and barrier commits alike, never
+        discards. This is the train→serve seam: hang a
+        ``DeployPublisher.publish`` here (every step, or every Nth) and
+        each committed version becomes live-deployable to a serving
+        cohort without the training loop knowing serving exists. The
+        hook runs on the commit path's thread with the decision already
+        final — it must be quick (publication is metadata staging; the
+        serve side pulls the bytes) and its exceptions are logged, never
+        allowed to poison the step."""
+        self._commit_hook = hook
+
+    def _fire_commit_hook(self, step: int) -> None:
+        hook = self._commit_hook
+        if hook is None:
+            return
+        try:
+            hook(step, self.num_participants())
+        except Exception as e:  # noqa: BLE001 — a publish failure must
+            # not discard a committed step; the next commit republishes.
+            self._logger.warn(f"commit hook failed at step {step}: {e!r}")
+
     def should_commit_async(
         self, timeout: "float | timedelta | None" = None
     ) -> Future:
@@ -1432,6 +1458,7 @@ class Manager:
                 self._checkpoint_transport.disallow_checkpoint()
                 self._step += 1
                 self._batches_committed += self.num_participants()
+                self._fire_commit_hook(self._step - 1)
                 fast_fut: Future = Future()
                 fast_fut.set_result(True)
                 fast_fut.local_should_commit = True  # type: ignore[attr-defined]
@@ -1481,6 +1508,7 @@ class Manager:
             if should_commit:
                 self._step += 1
                 self._batches_committed += self.num_participants()
+                self._fire_commit_hook(self._step - 1)
             return should_commit
 
         # The shared 1-thread executor serializes the barrier with any

@@ -63,6 +63,26 @@ Event vocabulary (producers in parentheses):
                                       planner — moved vs lower-bound
                                       bytes, spec fingerprints, plan
                                       cache state)
+    deploy_publish                   (serve.py: a committed weight
+                                      version staged on the train-side
+                                      publisher pair — version, units,
+                                      bytes)
+    deploy_start / deploy_done       (serve.py: one adoption — a
+                                      planner-compiled train→serve
+                                      transition fetched, version-gated
+                                      and flipped live; moved vs
+                                      lower-bound bytes, spec
+                                      fingerprints attached)
+    serve_flip                       (serve.py: a serving replica's
+                                      atomic version flip — it now
+                                      answers from the new version)
+    serve_reroute                    (serve.py: the cohort router moved
+                                      a request off a dead member onto
+                                      another live holder)
+    serve_join                       (serve.py: a killed serving replica
+                                      rejoined — shard healed FROM SERVE
+                                      PEERS, moved bytes and donor
+                                      members attached)
 
 Every event is stamped with a process-monotonic sequence number, wall +
 monotonic clocks, the bound replica_id/rank, and (when the emitter knows
@@ -124,6 +144,12 @@ EVENT_KINDS = (
     "stage_rebalance",
     "lease_break",
     "job_preempted",
+    "deploy_publish",
+    "deploy_start",
+    "deploy_done",
+    "serve_flip",
+    "serve_reroute",
+    "serve_join",
 )
 
 _DEFAULT_CAPACITY = 4096
@@ -133,9 +159,14 @@ _DEFAULT_CAPACITY = 4096
 _SPAN_PAIRS = {
     "quorum_start": "quorum_complete",
     "heal_start": "heal_done",
+    "deploy_start": "deploy_done",
 }
 _SPAN_ENDS = {v: k for k, v in _SPAN_PAIRS.items()}
-_SPAN_NAMES = {"quorum_start": "quorum", "heal_start": "heal"}
+_SPAN_NAMES = {
+    "quorum_start": "quorum",
+    "heal_start": "heal",
+    "deploy_start": "deploy",
+}
 
 
 class EventRecorder:
